@@ -1,0 +1,83 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline config (BASELINE.md config 1): multiclass Accuracy over 10-class
+random tensors — streaming update throughput on one chip, update+compute
+jit-compiled to XLA.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` compares
+against a torch-CPU eager loop of the same workload measured in-process when
+torch is available (the closest stand-in for the reference's eager per-batch
+update path).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_accuracy(n_batches: int = 50, batch_size: int = 8192, num_classes: int = 10):
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.classification import Accuracy
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random((n_batches, batch_size, num_classes), dtype=np.float32))
+    preds = preds / preds.sum(-1, keepdims=True)
+    target = jnp.asarray(rng.integers(0, num_classes, size=(n_batches, batch_size)))
+
+    metric = Accuracy(num_classes=num_classes, validate_args=False)
+    # warm up the jitted update + compute
+    metric.update(preds[0], target[0])
+    jax.block_until_ready(metric.compute())
+    metric.reset()
+
+    start = time.perf_counter()
+    for i in range(n_batches):
+        metric.update(preds[i], target[i])
+    value = metric.compute()
+    jax.block_until_ready(value)
+    elapsed = time.perf_counter() - start
+    return (n_batches * batch_size) / elapsed, float(value)
+
+
+def _bench_torch_reference(n_batches: int = 50, batch_size: int = 8192, num_classes: int = 10):
+    """Eager torch-CPU stand-in for the reference's update loop."""
+    try:
+        import torch
+    except Exception:
+        return None
+    rng = np.random.default_rng(0)
+    preds = torch.from_numpy(rng.random((n_batches, batch_size, num_classes), dtype=np.float32))
+    target = torch.from_numpy(rng.integers(0, num_classes, size=(n_batches, batch_size)))
+    correct = torch.zeros((), dtype=torch.long)
+    total = torch.zeros((), dtype=torch.long)
+    start = time.perf_counter()
+    for i in range(n_batches):
+        hard = preds[i].argmax(-1)
+        correct += (hard == target[i]).sum()
+        total += target[i].numel()
+    _ = (correct.float() / total.float()).item()
+    elapsed = time.perf_counter() - start
+    return (n_batches * batch_size) / elapsed
+
+
+def main() -> None:
+    ups, _value = _bench_accuracy()
+    ref = _bench_torch_reference()
+    vs_baseline = (ups / ref) if ref else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "accuracy_updates_per_sec",
+                "value": round(ups, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""metrics_tpu: a TPU-native (JAX/XLA) streaming-metrics framework.
+
+Brand-new implementation of the capability surface of the reference
+TorchMetrics snapshot (see SURVEY.md): ~80 streaming evaluation metrics over a
+functional `Metric` core with jit-compiled updates and mesh-collective state
+synchronization.
+"""
+
+__version__ = "0.1.0"
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from metrics_tpu.metric import CompositionalMetric, Metric
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MinMetric",
+    "SumMetric",
+]

@@ -1,0 +1,163 @@
+"""Stream aggregators (reference ``/root/reference/src/torchmetrics/aggregation.py:24-364``).
+
+``Max/Min/Sum/Cat/MeanMetric`` — scalar/tensor loggers with NaN policies.
+``ignore``/float-imputation NaN strategies are pure ``jnp.where`` rewrites and
+stay on the jit fast path; ``error``/``warn`` need a host readback and force
+eager updates.
+"""
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Common scaffolding for the aggregation metrics."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: str,
+        default_value: Union[Array, list],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("error", "warn", "ignore")
+        if not (isinstance(nan_strategy, float) or nan_strategy in allowed):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.state_name = state_name
+        if nan_strategy in ("error", "warn"):
+            self.jit_update = False  # needs a concrete NaN check on host
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Any, weight: Any = None) -> Any:
+        x = jnp.asarray(x, dtype=jnp.float32)
+        if weight is not None:
+            weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), x.shape)
+        if self.nan_strategy in ("error", "warn"):
+            if not isinstance(x, jax.core.Tracer) and bool(jnp.any(jnp.isnan(x))):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                keep = ~jnp.isnan(x)
+                if weight is not None:
+                    weight = weight[keep]
+                x = x[keep]
+        elif self.nan_strategy == "ignore":
+            keep = ~jnp.isnan(x)
+            if weight is not None:
+                weight = jnp.where(keep, weight, 0.0)
+            x = jnp.where(keep, x, self._nan_neutral())
+        else:  # float imputation
+            x = jnp.where(jnp.isnan(x), jnp.asarray(self.nan_strategy, dtype=x.dtype), x)
+        if weight is None:
+            return x
+        return x, weight
+
+    def _nan_neutral(self) -> float:
+        return 0.0
+
+    def update(self, value: Any) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return getattr(self, self.state_name)
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference ``aggregation.py:95``)."""
+
+    full_state_update = True
+    higher_is_better = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, state_name="max_value", **kwargs)
+
+    def _nan_neutral(self) -> float:
+        return float("-inf")
+
+    def update(self, value: Any) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.max_value = jnp.maximum(self.max_value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference ``aggregation.py:146``)."""
+
+    full_state_update = True
+    higher_is_better = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, state_name="min_value", **kwargs)
+
+    def _nan_neutral(self) -> float:
+        return float("inf")
+
+    def update(self, value: Any) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.min_value = jnp.minimum(self.min_value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference ``aggregation.py:197``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Any) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.sum_value = self.sum_value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate everything (reference ``aggregation.py:246``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Any) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(jnp.atleast_1d(value))
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:296-364``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Any, weight: Any = 1.0) -> None:
+        out = self._cast_and_nan_check_input(value, weight)
+        value, weight = out
+        if value.size == 0:
+            return
+        self.mean_value = self.mean_value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.mean_value / self.weight

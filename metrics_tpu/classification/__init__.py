@@ -1,0 +1,4 @@
+from metrics_tpu.classification.accuracy import Accuracy
+from metrics_tpu.classification.stat_scores import StatScores
+
+__all__ = ["Accuracy", "StatScores"]

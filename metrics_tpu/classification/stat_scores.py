@@ -1,0 +1,156 @@
+"""StatScores module metric — base for the stat-scores family.
+
+Parity target: ``/root/reference/src/torchmetrics/classification/stat_scores.py:24-244``.
+"""
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _stat_scores_compute,
+    _stat_scores_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class StatScores(Metric):
+    """Streaming tp/fp/tn/fn counts.
+
+    State layout (reference ``stat_scores.py:155-168``): fixed-shape tensors
+    with ``sum`` reduction when possible (micro → scalar, macro → ``(C,)``);
+    per-sample reductions (``reduce='samples'`` / ``mdmc_reduce='samplewise'``)
+    keep ``cat`` list states.
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+        self.validate_args = validate_args
+
+        if reduce not in ("micro", "macro", "samples"):
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+        if mdmc_reduce not in (None, "samplewise", "global"):
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        default: Callable[[], Any]
+        reduce_fn: Optional[str]
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            # fixed-shape streaming counts — the XLA-friendly layout
+            if reduce == "micro":
+                zeros_shape: Tuple[int, ...] = ()
+            else:  # macro
+                zeros_shape = (num_classes,)  # type: ignore[assignment]
+            default_factory = lambda: jnp.zeros(zeros_shape, dtype=jnp.int32)  # noqa: E731
+            reduce_fn = "sum"
+        else:
+            default_factory = list
+            reduce_fn = "cat"
+
+        self.mode = None
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default_factory(), dist_reduce_fx=reduce_fn)
+
+    def _pre_update(self, preds: Array, target: Array) -> None:
+        """Lock the input case on concrete values before the jitted body runs."""
+        from metrics_tpu.functional.classification.accuracy import _mode
+
+        try:
+            mode = _mode(
+                preds, target, self.threshold, self.top_k, self.num_classes,
+                self.multiclass, self.ignore_index, validate_args=self.validate_args,
+            )
+        except ValueError as err:
+            # only the traced-ambiguity error may be swallowed once the mode is
+            # locked; genuine validation errors (label out of range, ...)
+            # must propagate — see code-review finding on silent miscounts
+            if self.mode is not None and "Ambiguous integer inputs" in str(err):
+                return
+            raise
+        if self.mode is None:
+            self.mode = mode
+        elif self.mode != mode:
+            raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+        # infer the class count from concrete label values (jit can't), so the
+        # traced one-hot canonicalization has a static width
+        from metrics_tpu.utils.enums import DataType
+
+        if (
+            self.num_classes is None
+            and self.mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+            and not isinstance(preds, jax.core.Tracer)
+            and not isinstance(target, jax.core.Tracer)
+        ):
+            preds = jnp.asarray(preds)
+            target = jnp.asarray(target)
+            if jnp.issubdtype(preds.dtype, jnp.floating):
+                self.num_classes = preds.shape[1]
+            else:
+                self.num_classes = int(max(float(jnp.max(preds)), float(jnp.max(target)))) + 1
+
+    def update(self, preds: Array, target: Array) -> None:
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+            mode=self.mode,
+            validate_args=self.validate_args,
+        )
+        if isinstance(self.tp, list):
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+        else:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        """Concatenate list states (if any) into final count tensors."""
+        return (
+            dim_zero_cat(self.tp),
+            dim_zero_cat(self.fp),
+            dim_zero_cat(self.tn),
+            dim_zero_cat(self.fn),
+        )
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

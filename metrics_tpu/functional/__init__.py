@@ -1,0 +1,1 @@
+"""Stateless functional metrics (L4): pure jnp functions, one per metric."""

@@ -1,0 +1,32 @@
+from metrics_tpu.functional.classification.accuracy import accuracy
+from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa
+from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix
+from metrics_tpu.functional.classification.dice import dice
+from metrics_tpu.functional.classification.f_beta import f1_score, fbeta_score
+from metrics_tpu.functional.classification.hamming import hamming_distance
+from metrics_tpu.functional.classification.jaccard import jaccard_index
+from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef
+from metrics_tpu.functional.classification.precision_recall import (
+    precision,
+    precision_recall,
+    recall,
+)
+from metrics_tpu.functional.classification.specificity import specificity
+from metrics_tpu.functional.classification.stat_scores import stat_scores
+
+__all__ = [
+    "accuracy",
+    "cohen_kappa",
+    "confusion_matrix",
+    "dice",
+    "f1_score",
+    "fbeta_score",
+    "hamming_distance",
+    "jaccard_index",
+    "matthews_corrcoef",
+    "precision",
+    "precision_recall",
+    "recall",
+    "specificity",
+    "stat_scores",
+]

@@ -1,0 +1,83 @@
+"""Confusion matrix functional.
+
+Parity target: ``/root/reference/src/torchmetrics/functional/classification/confusion_matrix.py``.
+The bincount over ``target * C + preds`` lowers to a one-hot reduction on TPU
+(deterministic, no scatter serialization).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+    validate_args: bool = True,
+) -> Array:
+    """Unnormalized confusion counts: ``(C, C)``, or ``(C, 2, 2)`` for multilabel."""
+    preds, target, mode = _input_format_classification(
+        preds,
+        target,
+        threshold,
+        # pass num_classes so out-of-range labels fail validation loudly instead
+        # of being silently dropped by the fixed-length bincount
+        num_classes=None if multilabel else num_classes,
+        validate_args=validate_args,
+    )
+    if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+    if multilabel:
+        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
+        minlength = 4 * num_classes
+    else:
+        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
+        minlength = num_classes**2
+    bins = _bincount(unique_mapping, minlength=minlength)
+    if multilabel:
+        return bins.reshape(num_classes, 2, 2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / jnp.sum(confmat, axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / jnp.sum(confmat, axis=0, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / jnp.sum(confmat)
+        nan_mask = jnp.isnan(confmat)
+        if not isinstance(confmat, jax.core.Tracer) and bool(jnp.any(nan_mask)):
+            rank_zero_warn("nan values found in confusion matrix have been replaced with zeros.")
+        confmat = jnp.where(nan_mask, 0.0, confmat)
+    return confmat
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+    validate_args: bool = True,
+) -> Array:
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel, validate_args)
+    return _confusion_matrix_compute(confmat, normalize)

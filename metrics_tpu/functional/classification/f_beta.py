@@ -1,0 +1,113 @@
+"""F-beta / F1 functionals.
+
+Parity target: ``/root/reference/src/torchmetrics/functional/classification/f_beta.py``
+(``_fbeta_compute``), with sentinel masking instead of boolean drops.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall import _check_avg_arg
+from metrics_tpu.functional.classification.stat_scores import (
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0
+        zero = jnp.zeros_like(tp)
+        tp_s = jnp.sum(jnp.where(mask, tp, zero)).astype(jnp.float32)
+        fp_s = jnp.sum(jnp.where(mask, fp, zero)).astype(jnp.float32)
+        fn_s = jnp.sum(jnp.where(mask, fn, zero)).astype(jnp.float32)
+        precision = _safe_divide(tp_s, tp_s + fp_s)
+        recall = _safe_divide(tp_s, tp_s + fn_s)
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = _safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # avoid division by 0
+
+    # classes absent from preds AND target are meaningless → sentinel them
+    if average in (AverageMethod.NONE, None) and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = ((tp | fn) | fp) == 0
+        if ignore_index is not None:
+            meaningless = meaningless | (jnp.arange(tp.shape[-1]) == ignore_index)
+        num = jnp.where(meaningless, -1.0, num)
+        denom = jnp.where(meaningless, -1.0, denom)
+    elif ignore_index is not None:
+        if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+            idx = jnp.arange(num.shape[-1]) == ignore_index
+            num = jnp.where(idx, -1.0, num)
+            denom = jnp.where(idx, -1.0, denom)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        denom = jnp.where(cond, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    validate_args: bool = True,
+) -> Array:
+    _check_avg_arg(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass,
+        ignore_index=ignore_index, validate_args=validate_args,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    validate_args: bool = True,
+) -> Array:
+    return fbeta_score(
+        preds, target, 1.0, average, mdmc_average, ignore_index, num_classes,
+        threshold, top_k, multiclass, validate_args,
+    )

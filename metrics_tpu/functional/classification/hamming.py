@@ -1,0 +1,35 @@
+"""Hamming distance functional (reference ``functional/classification/hamming.py``)."""
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+Array = jax.Array
+
+
+def _hamming_distance_update(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    validate_args: bool = True,
+) -> Tuple[Array, int]:
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, validate_args=validate_args
+    )
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(
+    preds: Array, target: Array, threshold: float = 0.5, validate_args: bool = True
+) -> Array:
+    correct, total = _hamming_distance_update(preds, target, threshold, validate_args)
+    return _hamming_distance_compute(correct, total)

@@ -1,0 +1,69 @@
+"""Jaccard index (IoU) functional (reference ``functional/classification/jaccard.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+Array = jax.Array
+
+_jaccard_index_update = _confusion_matrix_update
+
+
+def _jaccard_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+) -> Array:
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    confmat = confmat.astype(jnp.float32)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    if average in ("none", None):
+        intersection = jnp.diag(confmat)
+        union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+        scores = jnp.where(union == 0, absent_score, intersection / jnp.where(union == 0, 1.0, union))
+        if ignore_index is not None and 0 <= ignore_index < num_classes:
+            scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1 :]])
+        return scores
+
+    if average == "macro":
+        scores = _jaccard_from_confmat(confmat, num_classes, "none", ignore_index, absent_score)
+        return jnp.mean(scores)
+
+    if average == "micro":
+        intersection = jnp.sum(jnp.diag(confmat))
+        union = jnp.sum(jnp.sum(confmat, axis=1) + jnp.sum(confmat, axis=0) - jnp.diag(confmat))
+        return intersection / union
+
+    # weighted
+    weights = jnp.sum(confmat, axis=1) / jnp.sum(confmat)
+    scores = _jaccard_from_confmat(confmat, num_classes, "none", ignore_index, absent_score)
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        weights = jnp.concatenate([weights[:ignore_index], weights[ignore_index + 1 :]])
+    return jnp.sum(weights * scores)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+    validate_args: bool = True,
+) -> Array:
+    confmat = _jaccard_index_update(
+        preds, target, num_classes, threshold, multilabel, validate_args=validate_args
+    )
+    return _jaccard_from_confmat(confmat, num_classes, average, ignore_index, absent_score)

@@ -1,0 +1,60 @@
+"""Specificity functional (reference ``functional/classification/specificity.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall import _check_avg_arg
+from metrics_tpu.functional.classification.stat_scores import (
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _specificity_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    numerator = tn
+    denominator = tn + fp
+    if average in (AverageMethod.NONE, None) and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        meaningless = ((tp | fn) | fp) == 0
+        numerator = jnp.where(meaningless, -1, numerator)
+        denominator = jnp.where(meaningless, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else (tn + fp),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def specificity(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    validate_args: bool = True,
+) -> Array:
+    _check_avg_arg(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass,
+        ignore_index=ignore_index, validate_args=validate_args,
+    )
+    return _specificity_compute(tp, fp, tn, fn, average, mdmc_average)

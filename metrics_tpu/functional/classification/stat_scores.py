@@ -1,0 +1,211 @@
+"""TP/FP/TN/FN engine — the shared core of the classification domain.
+
+Parity target: ``/root/reference/src/torchmetrics/functional/classification/stat_scores.py``
+(``_stat_scores`` 63-107, ``_stat_scores_update`` 110-193, ``_reduce_stat_scores``
+231-289).
+
+XLA design delta: the reference drops ignored/absent classes with boolean
+indexing (dynamic shapes).  Here absent classes are marked with a ``-1``
+denominator sentinel and masked inside :func:`_reduce_stat_scores` — identical
+math, fully static shapes, one fused XLA program.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    """Delete a class column (static index, so the output shape is static)."""
+    return jnp.concatenate([data[:, :idx], data[:, idx + 1 :]], axis=1)
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn from canonical binary ``(N, C)`` / ``(N, C, X)`` tensors.
+
+    Output shapes per reduce (matching the reference contract):
+    (N,C): micro → scalar, macro → (C,), samples → (N,)
+    (N,C,X): micro → (N,), macro → (N,C), samples → (N,X)
+    """
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = (0,) if preds.ndim == 2 else (2,)
+    else:  # samples
+        dim = (1,)
+
+    true_pred = target == preds
+    false_pred = target != preds
+    pos_pred = preds == 1
+    neg_pred = preds == 0
+
+    tp = jnp.sum(true_pred & pos_pred, axis=dim)
+    fp = jnp.sum(false_pred & pos_pred, axis=dim)
+    tn = jnp.sum(true_pred & neg_pred, axis=dim)
+    fn = jnp.sum(false_pred & neg_pred, axis=dim)
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _drop_negative_ignored_indices(
+    preds: Array, target: Array, ignore_index: int, mode: DataType
+) -> Tuple[Array, Array]:
+    """Eager-only path for negative ignore_index (dynamic shapes; reference :28-61)."""
+    if mode == DataType.MULTIDIM_MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating):
+        num_classes = preds.shape[1]
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+        target = target.reshape(-1)
+    if mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        keep = target != ignore_index
+        preds = preds[keep]
+        target = target[keep]
+    return preds, target
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    mode: Optional[DataType] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array, Array]:
+    """Canonicalize inputs and count stat scores (reference :110-193)."""
+    _negative_index_dropped = False
+    if ignore_index is not None and ignore_index < 0 and mode is not None:
+        preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
+        _negative_index_dropped = True
+
+    preds, target, _ = _input_format_classification(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+        validate_args=validate_args,
+        case=mode if not _negative_index_dropped else None,
+    )
+
+    if ignore_index is not None and ignore_index >= preds.shape[1]:
+        raise ValueError(
+            f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes"
+        )
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.moveaxis(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.moveaxis(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro" and not _negative_index_dropped:
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Stack [tp, fp, tn, fn, support] along a trailing dim (reference :196-229)."""
+    outputs = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """micro/macro/weighted/none/samples reduction with -1 "ignored" sentinel
+    (reference :231-289): zero denominators score ``zero_division``; negative
+    denominators drop the class from averaging (nan under ``average=None``).
+    """
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else weights.astype(jnp.float32)
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    # all-classes-ignored with average='weighted' → 0/0; impute zero_division
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0) > 0
+
+    if average in (AverageMethod.NONE, None):
+        return jnp.where(ignore_mask, jnp.nan, scores)
+    return jnp.sum(scores)
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Public functional: stacked [tp, fp, tn, fn, support] counts."""
+    if reduce not in ("micro", "macro", "samples"):
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in (None, "samplewise", "global"):
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        num_classes=num_classes,
+        top_k=top_k,
+        threshold=threshold,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+        validate_args=validate_args,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

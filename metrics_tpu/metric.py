@@ -1,0 +1,842 @@
+"""Core ``Metric`` runtime.
+
+Parity target: ``/root/reference/src/torchmetrics/metric.py`` (the ~950-line
+``Metric`` base class + ``CompositionalMetric``).
+
+TPU-first redesign (SURVEY.md §7 delta 1):
+
+* **State is a pytree**, not module attributes: ``self._state`` is a dict of
+  ``jax.Array`` (or Python lists of arrays for ``cat``-style list states).
+  Attribute sugar (``self.tp``) proxies into the dict so metric bodies read
+  like the reference.
+* **update/compute are pure functions underneath.**  The subclass writes an
+  imperative ``update(self, ...)``; the base class *functionalizes* it
+  (swap state in → trace → collect state out) and jit-compiles one XLA
+  program per input signature.  ``apply_update``/``apply_compute`` expose the
+  pure kernels directly so a metric can live inside a user's own
+  ``pjit``/``shard_map`` training step — the idiomatic JAX embedding, where
+  GSPMD inserts the cross-device reductions automatically.
+* **Sync is a backend call**, not an eager gather dance: each registered
+  state carries a ``dist_reduce_fx`` that maps 1:1 onto
+  ``psum/pmean/pmax/pmin/all_gather`` (see ``metrics_tpu/parallel``).
+  "unsync" (reference ``metric.py:444-464``) is just restoring the pre-sync
+  pytree — trivial with immutable arrays.
+"""
+
+import copy
+import functools
+import numbers
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.backend import Backend, get_backend, reduce_synced_state
+from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+_ALLOWED_REDUCE = ("sum", "mean", "max", "min", "cat")
+
+
+def _is_jittable_leaf(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray, numbers.Number, bool)) or x is None
+
+
+def jit_distributed_available() -> bool:
+    return jax.process_count() > 1
+
+
+class Metric(ABC):
+    """Base class for all metrics.
+
+    Subclasses implement :meth:`update` and :meth:`compute`, registering
+    streaming state in ``__init__`` via :meth:`add_state` — mirroring reference
+    ``metric.py:44-217`` ergonomics on a functional JAX core.
+
+    Args (all keyword-only, collected in ``**kwargs``):
+        compute_on_cpu: move list states to host memory after each update
+            (reference ``metric.py:91``).
+        dist_sync_on_step: synchronize state on every ``forward`` call
+            (reference ``metric.py:97``).
+        sync_on_compute: synchronize before ``compute`` (default True).
+        dist_sync_fn: custom sync callable ``(state, reduce_fns, backend) ->
+            state`` — the extension point Lightning uses in the reference
+            (``metric.py:105``).
+        axis_name: mesh axis name to sync over when running inside
+            ``shard_map``/``pmap``.
+        jit_update / jit_compute: override the class-level jit policy.
+    """
+
+    __jit_state_unsafe__ = False  # set True on metrics whose update cannot trace
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+    # class-level jit policy; metrics with host-side (string/dict) inputs override
+    jit_update_default: bool = True
+    jit_compute_default: bool = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        object.__setattr__(self, "_state", {})
+        self._defaults: Dict[str, Any] = {}
+        self._reduce_fns: Dict[str, Any] = {}
+        self._persistent: Dict[str, bool] = {}
+
+        self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
+        self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
+        self.sync_on_compute = kwargs.pop("sync_on_compute", True)
+        self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
+        self.axis_name = kwargs.pop("axis_name", None)
+        self.process_group = kwargs.pop("process_group", None)  # accepted for API parity; unused
+        self.jit_update = kwargs.pop("jit_update", self.jit_update_default)
+        self.jit_compute = kwargs.pop("jit_compute", self.jit_compute_default)
+        self.compute_with_cache = kwargs.pop("compute_with_cache", True)
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
+
+        self._update_count = 0
+        self._computed: Any = None
+        self._is_synced = False
+        self._cache: Optional[Dict[str, Any]] = None
+        self._cached_count: int = 0
+        self._jitted_update: Optional[Callable] = None
+        self._jitted_compute: Optional[Callable] = None
+        self._update_called_warned = False
+        self._dtype = jnp.float32
+        self._install_wrappers()
+
+    def _install_wrappers(self) -> None:
+        """Shadow ``update``/``compute`` with the runtime wrappers.
+
+        Instance-level wrapping (the reference does the same in
+        ``metric.py:__init__``) keeps ``super().update(...)`` calls raw and
+        survives subclass overrides.
+        """
+        object.__setattr__(self, "_update_impl", type(self).update.__get__(self))
+        object.__setattr__(self, "_compute_impl", type(self).compute.__get__(self))
+        object.__setattr__(self, "update", self._update_wrapper)
+        object.__setattr__(self, "compute", self._compute_wrapper)
+
+    # ------------------------------------------------------------------ state
+    def add_state(
+        self,
+        name: str,
+        default: Any,
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a streaming state (reference ``metric.py:150-217``).
+
+        ``default`` is either an array (tensor state, fixed shape) or an empty
+        Python list (list state, gathered with ``cat`` semantics).
+        """
+        if isinstance(dist_reduce_fx, str):
+            if dist_reduce_fx not in _ALLOWED_REDUCE:
+                raise ValueError(f"`dist_reduce_fx` must be one of {_ALLOWED_REDUCE}, callable or None")
+        elif dist_reduce_fx is not None and not callable(dist_reduce_fx):
+            raise ValueError("`dist_reduce_fx` must be a str, callable or None")
+        if isinstance(default, list):
+            if default:
+                raise ValueError("list states must default to the empty list")
+            value: Any = []
+        elif isinstance(default, (jax.Array, np.ndarray, numbers.Number)):
+            value = jnp.asarray(default)
+            default = value
+        else:
+            raise ValueError("state default must be an array, a number, or an empty list")
+        if not name.isidentifier():
+            raise ValueError(f"state name must be a valid identifier, got {name!r}")
+        self._defaults[name] = default
+        self._reduce_fns[name] = dist_reduce_fx
+        self._persistent[name] = persistent
+        self._state[name] = copy.copy(value) if isinstance(value, list) else value
+
+    def __getattr__(self, name: str) -> Any:
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            return state[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            state[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    @property
+    def state(self) -> Dict[str, Any]:
+        """The raw state pytree (orbax-serializable when no list states are pending)."""
+        return self._state
+
+    def _has_list_state(self) -> bool:
+        return any(isinstance(v, list) for v in self._state.values())
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    # ----------------------------------------------------------- pure kernels
+    def init_state(self) -> Dict[str, Any]:
+        """Fresh default state pytree (pure API)."""
+        return {
+            k: (list(v) if isinstance(v, list) else jnp.asarray(v))
+            for k, v in self._defaults.items()
+        }
+
+    def _run_with_state(self, state: Dict[str, Any], fn: Callable, args: tuple, kwargs: dict) -> Any:
+        """Run an imperative method body against a swapped-in state pytree."""
+        old = self.__dict__["_state"]
+        object.__setattr__(self, "_state", dict(state))
+        try:
+            out = fn(*args, **kwargs)
+            new_state = {k: self._state[k] for k in state}
+            return out, new_state
+        finally:
+            object.__setattr__(self, "_state", old)
+
+    def apply_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure update: ``(state, batch) -> state``.
+
+        Safe to call inside ``jax.jit``/``pjit``/``shard_map`` — this is the
+        TPU-idiomatic embedding of a metric into a compiled train step.
+        """
+        _, new_state = self._run_with_state(state, self._update_impl, args, kwargs)
+        return new_state
+
+    def apply_compute(self, state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
+        """Pure compute: ``state -> value``; syncs over ``axis_name`` if given."""
+        if axis_name is not None:
+            from metrics_tpu.parallel.backend import AxisBackend
+
+            state = self._sync_state_pure(state, AxisBackend(axis_name))
+        value, _ = self._run_with_state(state, self._compute_impl, (), {})
+        return value
+
+    def merge_state(self, other_state: Dict[str, Any]) -> None:
+        """Fold another instance's state into this one (host-side tree-merge)."""
+        merged = {}
+        for name, value in self._state.items():
+            other = other_state[name]
+            fx = self._reduce_fns[name]
+            if isinstance(value, list):
+                merged[name] = list(value) + list(other)
+            elif fx is None:
+                # no reduction declared: keep both contributions (gather-style),
+                # matching the sync path's all-gather semantics
+                merged[name] = jnp.concatenate(
+                    [jnp.atleast_1d(value), jnp.atleast_1d(other)], axis=0
+                )
+            elif fx == "sum":
+                merged[name] = value + other
+            elif fx == "mean":
+                merged[name] = (value + other) / 2.0
+            elif fx == "max":
+                merged[name] = jnp.maximum(value, other)
+            elif fx == "min":
+                merged[name] = jnp.minimum(value, other)
+            elif fx == "cat":
+                merged[name] = jnp.concatenate([jnp.atleast_1d(value), jnp.atleast_1d(other)], axis=0)
+            elif callable(fx):
+                merged[name] = fx(jnp.stack([value, other]))
+            else:
+                raise ValueError(f"cannot merge state {name!r} with reduce {fx!r}")
+        self._state.update(merged)
+
+    def _sync_state_pure(self, state: Dict[str, Any], backend: Backend) -> Dict[str, Any]:
+        out = {}
+        for name, value in state.items():
+            fx = self._reduce_fns[name]
+            if isinstance(value, list):
+                if not value:
+                    out[name] = value
+                    continue
+                value = dim_zero_cat(value)
+                out[name] = backend.all_gather_cat(value)
+            else:
+                out[name] = reduce_synced_state(value, fx, backend)
+        return out
+
+    # ---------------------------------------------------------------- update
+    @abstractmethod
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Fold a batch into state (imperative body over proxied state attrs)."""
+
+    @abstractmethod
+    def compute(self) -> Any:
+        """Compute the final value from (synced) state."""
+
+    def _can_jit(self, args: tuple, kwargs: dict) -> bool:
+        if not self.jit_update or self.__jit_state_unsafe__:
+            return False
+        if self._has_list_state():
+            return False
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        return all(_is_jittable_leaf(leaf) for leaf in leaves)
+
+    def _pre_update(self, *args: Any, **kwargs: Any) -> None:
+        """Eager hook run on concrete inputs before the jitted update.
+
+        Metrics with value-dependent input-case detection (classification)
+        lock their mode here so the traced body stays shape-static.
+        """
+
+    def _update_wrapper(self, *args: Any, **kwargs: Any) -> None:
+        if self._is_synced:
+            raise MetricsTPUUserError(
+                "The Metric has already been synced; re-syncing or updating while synced is forbidden."
+            )
+        self._pre_update(*args, **kwargs)
+        self._computed = None
+        self._update_count += 1
+        if self._can_jit(args, kwargs):
+            if self._jitted_update is None:
+                def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
+                    _, new_state = self._run_with_state(state, self._update_impl, args, kwargs)
+                    return new_state
+
+                self._jitted_update = jax.jit(pure_update)
+            try:
+                new_state = self._jitted_update(self._state, args, kwargs)
+            except (
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.NonConcreteBooleanIndexError,
+            ):
+                # update body needs concrete values; permanently fall back
+                self.jit_update = False
+                self._jitted_update = None
+                self._update_impl(*args, **kwargs)
+            else:
+                self._state.update(new_state)
+        else:
+            self._update_impl(*args, **kwargs)
+        if self.compute_on_cpu:
+            self._move_list_states_to_cpu()
+
+    def _move_list_states_to_cpu(self) -> None:
+        """Offload list states to host memory (reference ``metric.py:396-406``)."""
+        cpu = jax.devices("cpu")[0]
+        for name, value in self._state.items():
+            if isinstance(value, list):
+                self._state[name] = [jax.device_put(v, cpu) for v in value]
+
+    # ---------------------------------------------------------------- forward
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Update global state AND return the metric on this batch alone.
+
+        Fast path merges the pre-update state with the batch state through the
+        per-state reductions (reference ``metric.py:282-317``); the slow path
+        re-runs update on the cached global state
+        (reference ``metric.py:241-280``).
+        """
+        if self._is_synced:
+            raise MetricsTPUUserError("Calling forward while the metric is synced is forbidden.")
+        # custom callables and None-reduce *tensor* states have no O(1) merge
+        # rule — route them through the slow re-update path (the reference
+        # stacks them, which grows state shape every step; re-running update is
+        # always correct)
+        no_fast_merge = any(
+            (callable(fx) and not isinstance(fx, str))
+            or (fx is None and not isinstance(self._state[name], list))
+            for name, fx in self._reduce_fns.items()
+        )
+        if self.full_state_update or self.dist_sync_on_step or no_fast_merge:
+            return self._forward_full_state_update(*args, **kwargs)
+        return self._forward_reduce_state_update(*args, **kwargs)
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        self._update_wrapper(*args, **kwargs)
+        cache = self._copy_state()
+        cached_count = self._update_count
+        self.reset()
+        self._update_wrapper(*args, **kwargs)
+        should_sync = self.dist_sync_on_step
+        prev_sync = self.sync_on_compute
+        self.sync_on_compute = should_sync
+        try:
+            batch_val = self._compute_wrapper()
+        finally:
+            self.sync_on_compute = prev_sync
+        self._restore_state(cache)
+        self._update_count = cached_count
+        self._computed = None
+        self._is_synced = False
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        global_state = self._copy_state()
+        global_count = self._update_count
+        self.reset()
+        self._update_wrapper(*args, **kwargs)
+        prev_sync = self.sync_on_compute
+        self.sync_on_compute = False
+        try:
+            batch_val = self._compute_wrapper()
+        finally:
+            self.sync_on_compute = prev_sync
+        # O(1) merge of pre-update state with batch state (reference metric.py:319-346)
+        self._reduce_states(global_state, global_count)
+        self._update_count = global_count + 1
+        self._computed = None
+        self._is_synced = False
+        return batch_val
+
+    def _reduce_states(self, global_state: Dict[str, Any], global_count: int) -> None:
+        for name, global_val in global_state.items():
+            local_val = self._state[name]
+            fx = self._reduce_fns[name]
+            if isinstance(global_val, list) or fx == "cat" or fx is None:
+                if isinstance(global_val, list):
+                    self._state[name] = list(global_val) + list(local_val)
+                else:
+                    self._state[name] = jnp.concatenate(
+                        [jnp.atleast_1d(global_val), jnp.atleast_1d(local_val)], axis=0
+                    )
+            elif fx == "sum":
+                self._state[name] = global_val + local_val
+            elif fx == "mean":
+                self._state[name] = (global_count * global_val + local_val) / (global_count + 1)
+            elif fx == "max":
+                self._state[name] = jnp.maximum(global_val, local_val)
+            elif fx == "min":
+                self._state[name] = jnp.minimum(global_val, local_val)
+            else:  # pragma: no cover - guarded in forward
+                raise MetricsTPUUserError(f"cannot reduce state {name!r} with {fx!r}")
+
+    # ----------------------------------------------------------------- sync
+    def _copy_state(self) -> Dict[str, Any]:
+        return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
+
+    def _restore_state(self, cache: Dict[str, Any]) -> None:
+        self._state.update({k: (list(v) if isinstance(v, list) else v) for k, v in cache.items()})
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[bool] = None,
+    ) -> None:
+        """Gather + reduce state across participants (reference ``metric.py:408-442``)."""
+        if self._is_synced:
+            raise MetricsTPUUserError("The Metric has already been synced.")
+        backend = get_backend(self.axis_name)
+        if distributed_available is None:
+            distributed_available = backend.is_distributed()
+        self._cache = self._copy_state()
+        self._cached_count = self._update_count
+        if not should_sync or not distributed_available:
+            self._is_synced = True
+            return
+        dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+        if dist_sync_fn is not None:
+            new_state = dist_sync_fn(self._copy_state(), dict(self._reduce_fns), backend)
+        else:
+            new_state = self._sync_state_pure(self._state, backend)
+        self._state.update(new_state)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore the pre-sync local state (reference ``metric.py:444-464``)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsTPUUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsTPUUserError("The internal cache should exist to unsync the Metric.")
+        self._restore_state(self._cache)
+        self._update_count = self._cached_count
+        self._is_synced = False
+        self._cache = None
+
+    class _SyncContext:
+        def __init__(self, metric: "Metric", **kwargs: Any):
+            self.metric = metric
+            self.kwargs = kwargs
+            self.should_unsync = kwargs.pop("should_unsync", True)
+
+        def __enter__(self):
+            self.metric.sync(**self.kwargs)
+            return self.metric
+
+        def __exit__(self, *exc):
+            self.metric.unsync(should_unsync=self.should_unsync and self.metric._is_synced)
+
+    def sync_context(self, **kwargs: Any) -> "Metric._SyncContext":
+        return Metric._SyncContext(self, **kwargs)
+
+    # ---------------------------------------------------------------- compute
+    def _compute_wrapper(self) -> Any:
+        if self._update_count == 0 and not self._update_called_warned:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before the "
+                "``update`` method; this will lead to errors or nonsense values.",
+                UserWarning,
+            )
+            self._update_called_warned = True
+        if self._computed is not None and self.compute_with_cache:
+            return self._computed
+        with self.sync_context(should_sync=self.sync_on_compute):
+            value = self._run_compute()
+            self._computed = _squeeze_if_scalar(value)
+        return self._computed
+
+    def _run_compute(self) -> Any:
+        state = self._state
+        leaves = jax.tree_util.tree_leaves(state)
+        can_jit = (
+            self.jit_compute
+            and not self.__jit_state_unsafe__
+            and all(_is_jittable_leaf(leaf) for leaf in leaves)
+        )
+        if can_jit:
+            if self._jitted_compute is None:
+                def pure_compute(state: Dict[str, Any]) -> Any:
+                    out, _ = self._run_with_state(state, self._compute_impl, (), {})
+                    return out
+
+                self._jitted_compute = jax.jit(pure_compute)
+            try:
+                return self._jitted_compute(self._copy_state())
+            except (
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.NonConcreteBooleanIndexError,
+            ):
+                # compute body needs concrete values; permanently fall back
+                self.jit_compute = False
+                self._jitted_compute = None
+        return self._compute_impl()
+
+    # ------------------------------------------------------------------ reset
+    def reset(self) -> None:
+        """Reset state to defaults (reference ``metric.py:539-554``)."""
+        self._update_count = 0
+        self._computed = None
+        self._cache = None
+        self._is_synced = False
+        for name, default in self._defaults.items():
+            self._state[name] = [] if isinstance(default, list) else jnp.asarray(default)
+
+    def clone(self) -> "Metric":
+        return copy.deepcopy(self)
+
+    # ----------------------------------------------------- dtype / device mgmt
+    def to_device(self, device: Any) -> "Metric":
+        for name, value in self._state.items():
+            if isinstance(value, list):
+                self._state[name] = [jax.device_put(v, device) for v in value]
+            else:
+                self._state[name] = jax.device_put(value, device)
+        return self
+
+    def set_dtype(self, dst_type: Any) -> "Metric":
+        """Cast floating states (reference ``metric.py:588-614``)."""
+        self._dtype = dst_type
+
+        def cast(v: Array) -> Array:
+            return v.astype(dst_type) if jnp.issubdtype(v.dtype, jnp.floating) else v
+
+        for name, value in self._state.items():
+            if isinstance(value, list):
+                self._state[name] = [cast(v) for v in value]
+            else:
+                self._state[name] = cast(value)
+        self._jitted_update = None
+        self._jitted_compute = None
+        return self
+
+    def float(self) -> "Metric":
+        return self.set_dtype(jnp.float32)
+
+    def double(self) -> "Metric":
+        return self.set_dtype(jnp.float64)
+
+    def half(self) -> "Metric":
+        return self.set_dtype(jnp.bfloat16)
+
+    # ---------------------------------------------------------- persistence
+    def persistent(self, mode: bool = False) -> None:
+        for name in self._persistent:
+            self._persistent[name] = mode
+
+    def state_dict(self, keep_vars: bool = False) -> Dict[str, Any]:
+        """Snapshot persistent states as numpy (reference ``metric.py:654-672``)."""
+        out: Dict[str, Any] = {}
+        for name, value in self._state.items():
+            if not self._persistent[name]:
+                continue
+            if isinstance(value, list):
+                out[name] = [v if keep_vars else np.asarray(v) for v in value]
+            else:
+                out[name] = value if keep_vars else np.asarray(value)
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        for name, value in state_dict.items():
+            if name not in self._defaults:
+                raise KeyError(f"unknown state {name!r}")
+            if isinstance(value, list):
+                self._state[name] = [jnp.asarray(v) for v in value]
+            else:
+                self._state[name] = jnp.asarray(value)
+
+    def state_pytree(self) -> Dict[str, Any]:
+        """Full state as an orbax-serializable pytree (list states pre-concatenated)."""
+        out: Dict[str, Any] = {"_update_count": self._update_count}
+        for name, value in self._state.items():
+            out[name] = dim_zero_cat(value) if isinstance(value, list) and value else value
+        return out
+
+    def load_state_pytree(self, tree: Dict[str, Any]) -> None:
+        self._update_count = int(tree.pop("_update_count", 0))
+        for name, value in tree.items():
+            if isinstance(self._defaults.get(name), list) and not isinstance(value, list):
+                self._state[name] = [jnp.asarray(value)]
+            else:
+                self._state[name] = jnp.asarray(value) if not isinstance(value, list) else value
+
+    # ------------------------------------------------------------- pickling
+    def __getstate__(self) -> Dict[str, Any]:
+        d = self.__dict__.copy()
+        # bound-method wrappers are reinstalled in __setstate__
+        for key in ("update", "compute", "_update_impl", "_compute_impl"):
+            d.pop(key, None)
+        d["_jitted_update"] = None
+        d["_jitted_compute"] = None
+        d["_state"] = {
+            k: ([np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v))
+            for k, v in d["_state"].items()
+        }
+        d["_defaults"] = {
+            k: (v if isinstance(v, list) else np.asarray(v)) for k, v in d["_defaults"].items()
+        }
+        d["_cache"] = None
+        d["_computed"] = None
+        return d
+
+    def __setstate__(self, d: Dict[str, Any]) -> None:
+        d = dict(d)
+        d["_state"] = {
+            k: ([jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v))
+            for k, v in d["_state"].items()
+        }
+        d["_defaults"] = {
+            k: (v if isinstance(v, list) else jnp.asarray(v)) for k, v in d["_defaults"].items()
+        }
+        self.__dict__.update(d)
+        self._install_wrappers()
+
+    def __hash__(self) -> int:
+        hash_vals: List[Any] = [type(self).__name__]
+        for name, value in self._state.items():
+            hash_vals.append(name)
+            if isinstance(value, list):
+                hash_vals.extend(id(v) for v in value)
+            else:
+                hash_vals.append(id(value))
+        return hash(tuple(hash_vals))
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs the update signature accepts (reference ``metric.py:694-714``)."""
+        import inspect
+
+        sig = inspect.signature(self._update_impl)
+        params = sig.parameters
+        has_var_kw = any(p.kind == p.VAR_KEYWORD for p in params.values())
+        if has_var_kw:
+            return kwargs
+        return {k: v for k, v in kwargs.items() if k in params}
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    # ----------------------------------------------------- operator algebra
+    def __add__(self, other):
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other):
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other):
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other):
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other):
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other):
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other):
+        return CompositionalMetric(jnp.divide, self, other)
+
+    def __rtruediv__(self, other):
+        return CompositionalMetric(jnp.divide, other, self)
+
+    def __floordiv__(self, other):
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other):
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other):
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other):
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other):
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other):
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other):
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other):
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other):
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other):
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other):
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other):
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other):
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other):
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __eq__(self, other):  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other):  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __lt__(self, other):
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other):
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __gt__(self, other):
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other):
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __abs__(self):
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __neg__(self):
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self):
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __invert__(self):
+        return CompositionalMetric(jnp.logical_not, self, None)
+
+    def __getitem__(self, idx):
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy op over child metric computes (reference ``metric.py:845-953``)."""
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, Array, None],
+        metric_b: Union[Metric, float, int, Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (float, int)) else metric_a
+        self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (float, int)) else metric_b
+
+    def _sync_state_pure(self, state, backend):
+        return state  # children handle their own sync (reference metric.py:877-879)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a._update_wrapper(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b._update_wrapper(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def _update_wrapper(self, *args: Any, **kwargs: Any) -> None:
+        self._computed = None
+        self._update_count += 1
+        self._update_impl(*args, **kwargs)
+
+    def compute(self) -> Any:
+        val_a = self.metric_a._compute_wrapper() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b._compute_wrapper() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def _compute_wrapper(self) -> Any:
+        return self._compute_impl()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            return None
+        if val_b is None:
+            if self.metric_b is None:
+                return self.op(val_a)
+            return None
+        return self.op(val_a, val_b)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._update_count = 0
+        self._computed = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {getattr(self.op, '__name__', 'op')}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

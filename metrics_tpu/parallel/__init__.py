@@ -1,0 +1,23 @@
+"""Distributed runtime: mesh-axis collectives (ICI) + multihost DCN sync."""
+
+from metrics_tpu.parallel.backend import (
+    AxisBackend,
+    Backend,
+    MultihostBackend,
+    NullBackend,
+    axis_context,
+    current_axis,
+    get_backend,
+    reduce_synced_state,
+)
+
+__all__ = [
+    "AxisBackend",
+    "Backend",
+    "MultihostBackend",
+    "NullBackend",
+    "axis_context",
+    "current_axis",
+    "get_backend",
+    "reduce_synced_state",
+]

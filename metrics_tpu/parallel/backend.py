@@ -1,0 +1,247 @@
+"""Collective backend: the TPU-native replacement for the reference's
+``torch.distributed`` sync layer.
+
+Parity target: ``/root/reference/src/torchmetrics/utilities/distributed.py:96-151``
+(``gather_all_tensors`` with uneven-shape handling) and
+``/root/reference/src/torchmetrics/metric.py:348-442`` (``_sync_dist``).
+
+Three tiers (SURVEY.md §2.4):
+
+* :class:`AxisBackend` — inside a ``shard_map``/``pmap`` trace, states are
+  per-device and sync lowers onto **ICI collectives**
+  (``lax.psum/pmax/pmin/all_gather``).  This is the path used when a metric
+  update/compute runs SPMD over a ``jax.sharding.Mesh`` axis.
+* :class:`MultihostBackend` — eager multi-process (one controller per host),
+  sync crosses **DCN** via ``multihost_utils.process_allgather``; uneven
+  leading dims use the gather-sizes → pad → gather → trim scheme, the direct
+  analog of the reference's ``gather_all_tensors``.
+* :class:`NullBackend` — single process, single program: sync is the identity.
+
+``get_backend()`` picks the innermost active tier.  ``dist_reduce_fx`` names
+map onto collectives 1:1: ``sum→psum, mean→pmean, max→pmax, min→pmin,
+cat→all_gather(tiled)``.
+"""
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+_local = threading.local()
+
+
+def _axis_stack() -> List[str]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+class axis_context:
+    """Declare that metric code is running inside an SPMD collective context.
+
+    Usage::
+
+        def sharded_step(state, batch):
+            with mtpu.parallel.axis_context("data"):
+                state = metric.apply_update(state, *batch)
+            return state
+
+        shard_map(sharded_step, mesh=mesh, in_specs=..., out_specs=...)
+    """
+
+    def __init__(self, axis_name: Union[str, Sequence[str]]):
+        self.axis_name = axis_name
+
+    def __enter__(self) -> "axis_context":
+        _axis_stack().append(self.axis_name)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _axis_stack().pop()
+
+
+def current_axis() -> Optional[Union[str, Sequence[str]]]:
+    stack = _axis_stack()
+    return stack[-1] if stack else None
+
+
+class Backend:
+    """Protocol for metric-state synchronization."""
+
+    def is_distributed(self) -> bool:
+        raise NotImplementedError
+
+    def world_size(self) -> int:
+        raise NotImplementedError
+
+    def psum(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def pmean(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def pmax(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def pmin(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def all_gather_cat(self, x: Array) -> Array:
+        """Gather along dim 0 (concatenated across participants)."""
+        raise NotImplementedError
+
+    def all_gather_stack(self, x: Array) -> Array:
+        """Gather with a new leading participant dim."""
+        raise NotImplementedError
+
+
+class NullBackend(Backend):
+    def is_distributed(self) -> bool:
+        return False
+
+    def world_size(self) -> int:
+        return 1
+
+    def psum(self, x):
+        return x
+
+    def pmean(self, x):
+        return x
+
+    def pmax(self, x):
+        return x
+
+    def pmin(self, x):
+        return x
+
+    def all_gather_cat(self, x):
+        return x
+
+    def all_gather_stack(self, x):
+        return x[None]
+
+
+class AxisBackend(Backend):
+    """lax collectives over a named mesh axis (inside shard_map/pmap)."""
+
+    def __init__(self, axis_name: Union[str, Sequence[str]]):
+        self.axis_name = axis_name
+
+    def is_distributed(self) -> bool:
+        return True
+
+    def world_size(self) -> int:
+        names = self.axis_name if isinstance(self.axis_name, (tuple, list)) else (self.axis_name,)
+        size = 1
+        for n in names:
+            size *= lax.axis_size(n)
+        return size
+
+    def psum(self, x):
+        return lax.psum(x, self.axis_name)
+
+    def pmean(self, x):
+        return lax.pmean(x, self.axis_name)
+
+    def pmax(self, x):
+        return lax.pmax(x, self.axis_name)
+
+    def pmin(self, x):
+        return lax.pmin(x, self.axis_name)
+
+    def all_gather_cat(self, x):
+        x = jnp.atleast_1d(x)
+        return lax.all_gather(x, self.axis_name, tiled=True)
+
+    def all_gather_stack(self, x):
+        return lax.all_gather(x, self.axis_name)
+
+
+class MultihostBackend(Backend):
+    """Eager cross-host sync over DCN (one JAX process per host)."""
+
+    def is_distributed(self) -> bool:
+        return jax.process_count() > 1
+
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    def _gather(self, x: Array) -> Array:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(x)
+
+    def psum(self, x):
+        return jnp.sum(self._gather(jnp.asarray(x)[None]), axis=0)
+
+    def pmean(self, x):
+        return jnp.mean(self._gather(jnp.asarray(x)[None]), axis=0)
+
+    def pmax(self, x):
+        return jnp.max(self._gather(jnp.asarray(x)[None]), axis=0)
+
+    def pmin(self, x):
+        return jnp.min(self._gather(jnp.asarray(x)[None]), axis=0)
+
+    def all_gather_stack(self, x):
+        return self._gather(jnp.asarray(x)[None])
+
+    def all_gather_cat(self, x):
+        """Uneven-shape-safe gather: sizes → pad-to-max → gather → trim.
+
+        Direct analog of reference ``utilities/distributed.py:128-151``.
+        """
+        x = jnp.atleast_1d(jnp.asarray(x))
+        local_size = x.shape[0]
+        sizes = self._gather(jnp.asarray([local_size]))  # (P, 1)
+        sizes = [int(s) for s in sizes.reshape(-1)]
+        max_size = max(sizes)
+        if all(s == max_size for s in sizes):
+            gathered = self._gather(x[None])  # (P, n, ...)
+            return gathered.reshape((-1,) + x.shape[1:])
+        pad = [(0, max_size - local_size)] + [(0, 0)] * (x.ndim - 1)
+        padded = jnp.pad(x, pad)
+        gathered = self._gather(padded[None])  # (P, max, ...)
+        parts = [gathered[p, : sizes[p]] for p in range(len(sizes))]
+        return jnp.concatenate(parts, axis=0)
+
+
+def get_backend(axis_name: Optional[Union[str, Sequence[str]]] = None) -> Backend:
+    """Innermost active backend: explicit axis > ambient axis_context > multihost > null."""
+    axis = axis_name if axis_name is not None else current_axis()
+    if axis is not None:
+        return AxisBackend(axis)
+    if jax.process_count() > 1:
+        return MultihostBackend()
+    return NullBackend()
+
+
+_REDUCE_BY_NAME: dict = {}
+
+
+def reduce_synced_state(value: Any, reduce_fx: Union[str, Callable, None], backend: Backend) -> Any:
+    """Apply one state's ``dist_reduce_fx`` through the backend.
+
+    ``value`` is a single array (tensor state) or a list of arrays
+    (list state, pre-concatenated by the caller for ``cat``).
+    """
+    if reduce_fx == "sum":
+        return backend.psum(value)
+    if reduce_fx == "mean":
+        return backend.pmean(value)
+    if reduce_fx == "max":
+        return backend.pmax(value)
+    if reduce_fx == "min":
+        return backend.pmin(value)
+    if reduce_fx == "cat" or reduce_fx is None:
+        return backend.all_gather_cat(value)
+    if callable(reduce_fx):
+        # custom reduction: gather a stacked view and let the callable fold it,
+        # mirroring reference metric.py:363-374
+        gathered = backend.all_gather_stack(value)
+        return reduce_fx(gathered)
+    raise ValueError(f"Unknown dist_reduce_fx: {reduce_fx!r}")

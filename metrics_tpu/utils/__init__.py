@@ -1,0 +1,35 @@
+"""Utility layer for metrics_tpu.
+
+TPU-native re-design of the reference utility layer
+(``/root/reference/src/torchmetrics/utilities/``): pytree helpers, reductions,
+canonical input formatting, enums, optional-import registry, and rank-zero
+printing — all built on jax/jnp instead of torch.
+"""
+
+from metrics_tpu.utils.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+from metrics_tpu.utils.checks import check_forward_full_state_property
+
+__all__ = [
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "select_topk",
+    "to_categorical",
+    "to_onehot",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+    "check_forward_full_state_property",
+]

@@ -1,0 +1,28 @@
+"""Numeric helpers (reference ``utilities/compute.py:18-40``)."""
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul with bf16/fp16 inputs accumulated in fp32.
+
+    On TPU the MXU accumulates in fp32 natively, so instead of the reference's
+    fp16->fp32 round-trip (``utilities/compute.py:_safe_matmul``) we just ask
+    for an fp32 accumulation type.
+    """
+    return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y), with 0 * log(0) := 0 (reference ``_safe_xlogy``)."""
+    res = jax.scipy.special.xlogy(x, y)
+    return jnp.where(x == 0.0, jnp.zeros_like(res), res)
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """num / denom with 0/0 := 0 (pattern used across the reference functionals)."""
+    denom_safe = jnp.where(denom == 0, jnp.ones_like(denom), denom)
+    return jnp.where(denom == 0, jnp.zeros_like(num, dtype=jnp.result_type(num, 1.0)), num / denom_safe)

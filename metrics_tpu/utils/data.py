@@ -1,0 +1,156 @@
+"""Data / reduction helpers.
+
+Parity target: ``/root/reference/src/torchmetrics/utilities/data.py:36-271``
+(``dim_zero_*`` reductions, one-hot / top-k / categorical converters,
+``_bincount``, flatten helpers).  Everything here is jit-compatible jnp code
+with static shapes; host-only helpers (``get_group_indexes``) are numpy.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array], tuple]) -> Array:
+    """Concatenate a list state along dim 0 (identity on a lone array)."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    if not isinstance(x, (list, tuple)):
+        return x
+    if len(x) == 0:
+        raise ValueError("No samples to concatenate")
+    x = [jnp.atleast_1d(v) for v in x]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Dict:
+    """Flatten dict-of-dicts one level."""
+    new_dict = {}
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                new_dict[k] = v
+        else:
+            new_dict[key] = value
+    return new_dict
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert a dense label tensor ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Mirrors reference ``utilities/data.py:to_onehot`` but uses
+    ``jax.nn.one_hot`` (XLA-friendly scatter-free formulation).
+    """
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1  # host sync; eager-only path
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=label_tensor.dtype)
+    # one_hot appends the class dim last; the canonical layout is (N, C, ...)
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference ``select_topk``).
+
+    Implemented with ``lax.top_k`` + one-hot sum instead of scatter so it maps
+    onto the TPU VPU without serializing.
+    """
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    onehot = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32)  # (..., k, C)
+    mask = jnp.clip(jnp.sum(onehot, axis=-2), 0, 1)
+    return jnp.moveaxis(mask, -1, dim).astype(jnp.int32)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/one-hot -> dense labels via argmax (reference ``to_categorical``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-length bincount (XLA needs a fixed output shape).
+
+    The reference needs a deterministic fallback loop on CUDA
+    (``utilities/data.py:_bincount``); on TPU ``jnp.bincount`` with a static
+    ``length`` lowers to a one-hot matmul-style reduction and is already
+    deterministic.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _movedim(x: Array, source: int, destination: int) -> Array:
+    return jnp.moveaxis(x, source, destination)
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.squeeze() if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    return jax.tree_util.tree_map(_squeeze_scalar_element_tensor, data)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all elements of type ``dtype``.
+
+    Reference: ``utilities/data.py:apply_to_collection``.  Lists are mapped
+    element-wise (they are metric list-states, not pytree internals).
+    """
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)):
+        out = [apply_to_collection(d, dtype, function, *args, **kwargs) for d in data]
+        return type(data)(out) if isinstance(data, tuple) else out
+    if isinstance(data, dict):
+        return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+    return data
+
+
+def get_group_indexes(indexes: Union[Array, np.ndarray]) -> List[np.ndarray]:
+    """Group row positions by query id (retrieval metrics).
+
+    Host-side helper (reference ``utilities/data.py:get_group_indexes``); the
+    jit path uses ``jax.ops.segment_sum`` instead — see
+    ``metrics_tpu/functional/retrieval/_segment.py``.
+    """
+    indexes = np.asarray(indexes)
+    groups: Dict[int, List[int]] = {}
+    for i, idx in enumerate(indexes.tolist()):
+        groups.setdefault(idx, []).append(i)
+    return [np.asarray(v, dtype=np.int64) for v in groups.values()]
+
+
+def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    if a.shape != b.shape:
+        return False
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))

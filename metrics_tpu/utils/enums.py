@@ -1,0 +1,50 @@
+"""Enums (reference ``utilities/enums.py:48-83``)."""
+
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """Case-insensitive string enum."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            keys = [func.lower() for func in cls.__members__]
+            index = keys.index(str(value).lower())
+            return list(cls.__members__.values())[index]
+        except ValueError:
+            return None
+
+    def __eq__(self, other: Union[str, "EnumStr", None]) -> bool:  # type: ignore[override]
+        other = other.value if isinstance(other, Enum) else str(other)
+        return self.value.lower() == other.lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Classification input case."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Reduction over classes."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class handling."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

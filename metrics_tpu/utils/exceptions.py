@@ -1,0 +1,9 @@
+"""Exceptions (reference ``utilities/exceptions.py``)."""
+
+
+class MetricsTPUUserError(Exception):
+    """Error raised on wrong usage of the metrics API."""
+
+
+# alias kept for drop-in familiarity with the reference name
+TorchMetricsUserError = MetricsTPUUserError

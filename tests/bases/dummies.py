@@ -1,0 +1,57 @@
+"""Dummy metrics for base-runtime tests (reference ``testers.py:573-621``)."""
+
+import jax.numpy as jnp
+
+from metrics_tpu import Metric
+
+
+class DummyMetric(Metric):
+    name = "Dummy"
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, *args, **kwargs):
+        pass
+
+    def compute(self):
+        return self.x
+
+
+class DummyListMetric(Metric):
+    name = "DummyList"
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self, x=None):
+        if x is not None:
+            self.x.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricSum(DummyMetric):
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, dtype=jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricDiff(DummyMetric):
+    def update(self, y):
+        self.x = self.x - jnp.asarray(y, dtype=jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricMultiOutput(DummyMetricSum):
+    def compute(self):
+        return [self.x, self.x]

@@ -1,0 +1,75 @@
+"""Aggregation metric tests (reference ``tests/unittests/bases/test_aggregation.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    "metric_cls, fn",
+    [
+        (MaxMetric, np.max),
+        (MinMetric, np.min),
+        (SumMetric, np.sum),
+        (MeanMetric, np.mean),
+    ],
+)
+def test_aggregators(metric_cls, fn):
+    values = np.random.randn(10, 5).astype(np.float32)
+    m = metric_cls()
+    for row in values:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), fn(values), rtol=1e-5)
+
+
+def test_cat_metric():
+    values = np.random.randn(6, 3).astype(np.float32)
+    m = CatMetric()
+    for row in values:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), values.reshape(-1), rtol=1e-6)
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(1.0, weight=2.0)
+    m.update(3.0, weight=6.0)
+    np.testing.assert_allclose(float(m.compute()), (1 * 2 + 3 * 6) / 8, rtol=1e-6)
+
+
+@pytest.mark.parametrize("strategy", ["error", "warn", "ignore", 0.0])
+def test_nan_strategies(strategy):
+    values = jnp.asarray([1.0, float("nan"), 3.0])
+    m = SumMetric(nan_strategy=strategy)
+    if strategy == "error":
+        with pytest.raises(RuntimeError, match="nan"):
+            m.update(values)
+    elif strategy == "warn":
+        with pytest.warns(UserWarning):
+            m.update(values)
+        assert float(m.compute()) == 4.0
+    else:
+        m.update(values)
+        assert float(m.compute()) == 4.0
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        SumMetric(nan_strategy="whatever")
+
+
+def test_mean_metric_scalar_and_broadcast_weights():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0, 3.0]), weight=jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(float(m.compute()), (1 + 4 + 9) / 6, rtol=1e-6)
+
+
+def test_aggregator_forward():
+    m = SumMetric()
+    batch_val = m(jnp.asarray([1.0, 2.0]))
+    assert float(batch_val) == 3.0
+    batch_val = m(jnp.asarray([4.0]))
+    assert float(batch_val) == 4.0
+    assert float(m.compute()) == 7.0

@@ -1,0 +1,106 @@
+"""Operator-algebra tests (reference ``tests/unittests/bases/test_composition.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CompositionalMetric
+
+from tests.bases.dummies import DummyMetricDiff, DummyMetricSum
+
+
+def test_add():
+    a, b = DummyMetricSum(), DummyMetricDiff()
+    c = a + b
+    a.update(2.0)
+    b.update(1.0)
+    assert float(c.compute()) == 2.0 - 1.0
+
+
+def test_add_scalar():
+    a = DummyMetricSum()
+    c = a + 5.0
+    a.update(2.0)
+    assert float(c.compute()) == 7.0
+    c2 = 5.0 + a
+    assert float(c2.compute()) == 7.0
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a, b: a + b, 6.0),
+        (lambda a, b: a - b, 2.0),
+        (lambda a, b: a * b, 8.0),
+        (lambda a, b: a / b, 2.0),
+        (lambda a, b: a**b, 16.0),
+        (lambda a, b: a % b, 0.0),
+        (lambda a, b: a // b, 2.0),
+    ],
+)
+def test_binary_ops(op, expected):
+    a, b = DummyMetricSum(), DummyMetricSum()
+    c = op(a, b)
+    a.update(4.0)
+    b.update(1.0)
+    b.update(1.0)
+    assert float(c.compute()) == expected
+
+
+def test_comparison_ops():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    a.update(4.0)
+    b.update(2.0)
+    assert bool((a > b).compute())
+    assert not bool((a < b).compute())
+    assert not bool((a == b).compute())
+    assert bool((a != b).compute())
+    assert bool((a >= b).compute())
+    assert not bool((a <= b).compute())
+
+
+def test_unary_ops():
+    a = DummyMetricSum()
+    a.update(-3.0)
+    assert float(abs(a).compute()) == 3.0
+    assert float((-a).compute()) == -3.0
+
+
+def test_getitem():
+    a = DummyMetricSum()
+    a.update(jnp.asarray([1.0, 2.0, 3.0]))
+    c = a[1]
+    assert float(c.compute()) == 2.0
+
+
+def test_update_routes_to_children():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    c = a + b
+    c.update(3.0)
+    assert float(a.x) == 3.0
+    assert float(b.x) == 3.0
+    assert float(c.compute()) == 6.0
+
+
+def test_forward_composition():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    c = a + b
+    out = c(1.0)
+    assert float(out) == 2.0
+
+
+def test_nested_composition():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    c = (a + b) * 2.0
+    a.update(1.0)
+    b.update(2.0)
+    assert float(c.compute()) == 6.0
+
+
+def test_compositional_reset():
+    a = DummyMetricSum()
+    c = a + 1.0
+    a.update(2.0)
+    assert float(c.compute()) == 3.0
+    c.reset()
+    assert float(a.x) == 0.0

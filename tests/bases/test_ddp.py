@@ -1,0 +1,141 @@
+"""Distributed sync tests on the virtual 8-device CPU mesh.
+
+TPU translation of reference ``tests/unittests/bases/test_ddp.py``: real lax
+collectives under ``shard_map`` stand in for gloo process groups.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.parallel.backend import (
+    AxisBackend,
+    MultihostBackend,
+    NullBackend,
+    axis_context,
+    current_axis,
+    get_backend,
+)
+
+from tests.bases.dummies import DummyListMetric, DummyMetricSum
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("ddp",))
+
+
+def test_sum_sync_under_shard_map():
+    """sum states psum across devices (reference test_ddp_sum)."""
+    m = DummyMetricSum()
+    mesh = _mesh(4)
+
+    def run(x):
+        state = m.init_state()
+        state = m.apply_update(state, x.squeeze())
+        value = m.apply_compute(state, axis_name="ddp")
+        return jnp.asarray(value)[None]
+
+    xs = jnp.arange(4, dtype=jnp.float32)
+    out = jax.shard_map(run, mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp"))(xs)
+    np.testing.assert_allclose(np.asarray(out), np.full(4, 6.0))
+
+
+def test_cat_sync_under_shard_map():
+    """list states all-gather + concat across devices (reference test_ddp_cat)."""
+    m = DummyListMetric()
+    mesh = _mesh(2)
+
+    def run(x):
+        state = m.init_state()
+        state = m.apply_update(state, x)  # shard stays 2D: (1, 3)
+        value = m.apply_compute(state, axis_name="ddp")
+        return value
+
+    xs = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    out = jax.shard_map(run, mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp"))(xs)
+    # each device returns the full gathered (2, 3) state -> concat gives (4, 3)
+    per_dev = np.asarray(out).reshape(2, -1)
+    for row in per_dev:
+        np.testing.assert_allclose(row, np.arange(6.0))
+
+
+def test_axis_backend_ops():
+    mesh = _mesh(8)
+
+    def run(x):
+        b = AxisBackend("ddp")
+        return jnp.stack(
+            [b.psum(x.squeeze()), b.pmean(x.squeeze()), b.pmax(x.squeeze()), b.pmin(x.squeeze())]
+        )[None]
+
+    xs = jnp.arange(8, dtype=jnp.float32)
+    out = jax.shard_map(run, mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp"))(xs)
+    row = np.asarray(out)[0]
+    np.testing.assert_allclose(row, [28.0, 3.5, 7.0, 0.0])
+
+
+def test_axis_context_routing():
+    assert current_axis() is None
+    assert isinstance(get_backend(), NullBackend)
+    with axis_context("data"):
+        assert current_axis() == "data"
+        assert isinstance(get_backend(), AxisBackend)
+    assert current_axis() is None
+
+
+def test_multihost_uneven_gather_simulated():
+    """Uneven-shape pad→gather→trim (reference test_ddp uneven gather 63-81)."""
+    shards = [jnp.arange(3, dtype=jnp.float32), jnp.arange(3, 5, dtype=jnp.float32)]
+
+    class FakeMultihost(MultihostBackend):
+        def __init__(self, rank):
+            self.rank = rank
+
+        def _gather(self, x):
+            # emulate two processes: pad each local shard like each rank would
+            outs = []
+            for shard in shards:
+                local = jnp.atleast_1d(shard)
+                if x.shape[1:] and x.shape[1] >= local.shape[0]:
+                    pad = [(0, x.shape[1] - local.shape[0])] + [(0, 0)] * (local.ndim - 1)
+                    local = jnp.pad(local, pad)
+                outs.append(local[None] if local.shape != x.shape[1:] else local[None])
+            # emulate size-gather (x is (1,) of local size) or payload gather
+            if x.shape == (1, 1) or x.shape == (1,):
+                return jnp.stack([jnp.asarray([s.shape[0]]) for s in shards])
+            return jnp.concatenate(outs, axis=0)
+
+    b = FakeMultihost(0)
+    out = b.all_gather_cat(shards[0])
+    np.testing.assert_allclose(np.asarray(out), [0.0, 1.0, 2.0, 3.0, 4.0])
+
+
+def test_sync_context_restores_state():
+    """sync caches local state; unsync restores (reference test_ddp:135-241)."""
+    m = DummyMetricSum()
+    m.update(3.0)
+    with m.sync_context():
+        assert m._is_synced
+    assert not m._is_synced
+    assert float(m.x) == 3.0
+    m.update(1.0)
+    assert float(m.compute()) == 4.0
+
+
+def test_compositional_metric_under_shard_map():
+    """compositional metrics sync their children (reference test_ddp:84-91)."""
+    a, b = DummyMetricSum(), DummyMetricSum()
+    mesh = _mesh(2)
+
+    def run(x):
+        sa = a.apply_update(a.init_state(), x.squeeze())
+        sb = b.apply_update(b.init_state(), 2.0 * x.squeeze())
+        va = a.apply_compute(sa, axis_name="ddp")
+        vb = b.apply_compute(sb, axis_name="ddp")
+        return (va + vb)[None]
+
+    xs = jnp.arange(2, dtype=jnp.float32)
+    out = jax.shard_map(run, mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp"))(xs)
+    np.testing.assert_allclose(np.asarray(out), np.full(2, 3.0))

@@ -1,0 +1,243 @@
+"""Core Metric API tests (reference ``tests/unittests/bases/test_metric.py``)."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+from tests.bases.dummies import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="state name must be a valid identifier"):
+        m = DummyMetric()
+        m.add_state("not an identifier", jnp.asarray(0.0), "sum")
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be"):
+        m = DummyMetric()
+        m.add_state("x2", jnp.asarray(0.0), "xyz")
+    with pytest.raises(ValueError, match="state default must be"):
+        m = DummyMetric()
+        m.add_state("x3", "string", "sum")
+    with pytest.raises(ValueError, match="list states must default to the empty list"):
+        m = DummyMetric()
+        m.add_state("x4", [jnp.asarray(1.0)], "cat")
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state_sets_attributes():
+    m = DummyMetric()
+    assert float(m.x) == 0.0
+    m.x = jnp.asarray(5.0)
+    assert float(m._state["x"]) == 5.0
+
+
+def test_reset():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert float(m.x) == 2.0
+    m.reset()
+    assert float(m.x) == 0.0
+
+    lm = DummyListMetric()
+    lm.update(jnp.asarray([1.0]))
+    assert len(lm.x) == 1
+    lm.reset()
+    assert lm.x == []
+
+
+def test_reset_compute():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert float(m.compute()) == 2.0
+    m.reset()
+    assert float(m.compute()) == 0.0
+
+
+def test_update():
+    m = DummyMetricSum()
+    assert float(m.x) == 0
+    assert m._update_count == 0
+    m.update(1)
+    assert m._update_count == 1
+    assert float(m.x) == 1
+    m.update(2)
+    assert float(m.x) == 3
+    assert m._update_count == 2
+
+
+def test_compute_cached():
+    m = DummyMetricSum()
+    m.update(1)
+    assert float(m.compute()) == 1
+    m.update(1)
+    assert float(m.compute()) == 2
+    # cached until next update
+    assert float(m.compute()) == 2
+
+
+def test_forward():
+    m = DummyMetricSum()
+    val = m(1)
+    assert float(val) == 1  # batch value
+    assert float(m.compute()) == 1
+    val = m(2)
+    assert float(val) == 2  # batch-only value
+    assert float(m.compute()) == 3  # accumulated
+
+
+def test_forward_full_state():
+    class FullStateSum(DummyMetricSum):
+        full_state_update = True
+
+    m = FullStateSum()
+    assert float(m(1)) == 1
+    assert float(m(2)) == 2
+    assert float(m.compute()) == 3
+
+
+def test_pickle():
+    m = DummyMetricSum()
+    m.update(2.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.x) == 2.0
+    m2.update(3.0)
+    assert float(m2.compute()) == 5.0
+    assert float(m.compute()) == 2.0
+
+
+def test_clone():
+    m = DummyMetricSum()
+    m.update(5.0)
+    m2 = m.clone()
+    m2.update(1.0)
+    assert float(m.x) == 5.0
+    assert float(m2.x) == 6.0
+
+
+def test_state_dict():
+    m = DummyMetric()
+    assert m.state_dict() == {}  # non-persistent by default
+    m.persistent(True)
+    sd = m.state_dict()
+    assert "x" in sd
+    m.x = jnp.asarray(3.0)
+    m.load_state_dict({"x": np.asarray(7.0)})
+    assert float(m.x) == 7.0
+
+
+def test_state_pytree_roundtrip():
+    m = DummyMetricSum()
+    m.update(4.0)
+    tree = m.state_pytree()
+    m2 = DummyMetricSum()
+    m2.load_state_pytree(tree)
+    assert float(m2.compute()) == 4.0
+    assert m2._update_count == 1
+
+
+def test_hash():
+    m1, m2 = DummyMetric(), DummyMetric()
+    assert hash(m1) != hash(m2) or m1._state["x"] is m2._state["x"]
+    lm1, lm2 = DummyListMetric(), DummyListMetric()
+    lm1.update(jnp.asarray([1.0]))
+    h1 = hash(lm1)
+    lm1.update(jnp.asarray([2.0]))
+    assert hash(lm1) != h1
+
+
+def test_update_while_synced_raises():
+    m = DummyMetricSum()
+    m.update(1.0)
+    m.sync(should_sync=False)
+    with pytest.raises(MetricsTPUUserError, match="already been synced"):
+        m.update(1.0)
+    m.unsync()
+    m.update(1.0)
+
+
+def test_double_sync_unsync_raises():
+    m = DummyMetricSum()
+    m.sync(should_sync=False)
+    with pytest.raises(MetricsTPUUserError):
+        m.sync()
+    m.unsync()
+    with pytest.raises(MetricsTPUUserError):
+        m.unsync()
+
+
+def test_metric_jits_update():
+    m = DummyMetricSum()
+    for i in range(5):
+        m.update(float(i))
+    assert m._jitted_update is not None
+    assert float(m.compute()) == 10.0
+
+
+def test_apply_update_pure():
+    m = DummyMetricSum()
+    state = m.init_state()
+    state = m.apply_update(state, 2.0)
+    state = m.apply_update(state, 3.0)
+    assert float(state["x"]) == 5.0
+    assert float(m.x) == 0.0  # instance untouched
+    assert float(m.apply_compute(state)) == 5.0
+
+
+def test_apply_update_inside_jit():
+    m = DummyMetricSum()
+
+    @jax.jit
+    def step(state, x):
+        return m.apply_update(state, x)
+
+    state = m.init_state()
+    for i in range(4):
+        state = step(state, jnp.asarray(float(i)))
+    assert float(m.apply_compute(state)) == 6.0
+
+
+def test_merge_state():
+    m1, m2 = DummyMetricSum(), DummyMetricSum()
+    m1.update(2.0)
+    m2.update(5.0)
+    m1.merge_state(m2.state)
+    assert float(m1.compute()) == 7.0
+
+
+def test_set_dtype():
+    m = DummyMetricSum()
+    m.update(1.5)
+    m.half()
+    assert m.x.dtype == jnp.bfloat16
+    m.float()
+    assert m.x.dtype == jnp.float32
+
+
+def test_compute_on_cpu():
+    m = DummyListMetric(compute_on_cpu=True)
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert all("cpu" in str(d).lower() or "Cpu" in str(d) for v in m.x for d in v.devices())
+
+
+def test_filter_kwargs():
+    class KwargMetric(DummyMetricSum):
+        def update(self, x, extra=None):
+            super().update(x)
+
+    m = KwargMetric()
+    kw = m._filter_kwargs(x=1.0, extra=2, junk=3)
+    assert set(kw) == {"x", "extra"}
+
+
+def test_zero_update_compute_warns():
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="was called before"):
+        m.compute()

@@ -1,0 +1,63 @@
+"""Deterministic classification input banks (reference
+``tests/unittests/classification/inputs.py``): one named-tuple per shape regime."""
+
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(1)
+
+
+def _prob(*shape):
+    x = _rng.random(shape, dtype=np.float32)
+    return x
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_binary_prob_inputs = Input(
+    preds=_prob(NUM_BATCHES, BATCH_SIZE),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_binary_inputs = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multilabel_prob_inputs = Input(
+    preds=_prob(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_multilabel_inputs = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_multiclass_prob_inputs = Input(
+    preds=_softmax(_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), dtype=np.float32), -1),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multiclass_inputs = Input(
+    preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multidim_multiclass_prob_inputs = Input(
+    preds=_softmax(_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM), dtype=np.float32), 2),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+
+_multidim_multiclass_inputs = Input(
+    preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)

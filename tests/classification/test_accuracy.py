@@ -1,0 +1,142 @@
+"""Accuracy tests vs sklearn oracles (reference ``tests/unittests/classification/test_accuracy.py``)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.functional.classification.accuracy import accuracy
+
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy_ref(preds: np.ndarray, target: np.ndarray, subset_accuracy: bool = False):
+    """Flatten any regime into sklearn's accuracy_score (independent oracle)."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    if preds.dtype.kind == "f":
+        if preds.ndim == target.ndim:  # binary / multilabel probabilities
+            preds = (preds >= THRESHOLD).astype(np.int64)
+        else:  # class-dim probabilities
+            preds = preds.argmax(axis=1) if preds.ndim == target.ndim + 1 else preds
+    if preds.ndim == target.ndim and preds.ndim >= 2 and not subset_accuracy:
+        # label-wise / element-wise accuracy
+        return sk_accuracy(target.reshape(-1), preds.reshape(-1))
+    if preds.ndim == target.ndim and preds.ndim >= 2 and subset_accuracy:
+        sample_ok = (preds == target).reshape(preds.shape[0], -1).all(axis=1)
+        return sample_ok.mean()
+    if preds.ndim == target.ndim + 1:  # already argmaxed above
+        pass
+    return sk_accuracy(target.reshape(-1), np.asarray(preds).reshape(-1))
+
+
+class TestAccuracy(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize(
+        "preds, target, subset_accuracy",
+        [
+            (_binary_prob_inputs.preds, _binary_prob_inputs.target, False),
+            (_binary_inputs.preds, _binary_inputs.target, False),
+            (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, False),
+            (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, True),
+            (_multilabel_inputs.preds, _multilabel_inputs.target, False),
+            (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, False),
+            (_multiclass_inputs.preds, _multiclass_inputs.target, False),
+            (_multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target, False),
+            (_multidim_multiclass_inputs.preds, _multidim_multiclass_inputs.target, False),
+        ],
+    )
+    def test_accuracy_class(self, ddp, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            reference_fn=lambda p, t: _sk_accuracy_ref(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize(
+        "preds, target, subset_accuracy",
+        [
+            (_binary_prob_inputs.preds, _binary_prob_inputs.target, False),
+            (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, False),
+            (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, True),
+        ],
+    )
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=lambda p, t: accuracy(
+                p, t, threshold=THRESHOLD, subset_accuracy=subset_accuracy
+            ),
+            reference_fn=lambda p, t: _sk_accuracy_ref(p, t, subset_accuracy),
+        )
+
+
+def test_accuracy_topk():
+    preds = np.asarray(
+        [
+            [0.35, 0.4, 0.25],
+            [0.1, 0.5, 0.4],
+            [0.2, 0.1, 0.7],
+            [0.5, 0.3, 0.2],
+        ],
+        dtype=np.float32,
+    )
+    target = np.asarray([0, 2, 2, 0])
+    # top-2: rows 0 (0 in {1,0}), 1 (2 in {1,2}), 2 (2 in {2,0|1}), 3 (0 in {0,1})
+    import jax.numpy as jnp
+
+    res = accuracy(jnp.asarray(preds), jnp.asarray(target), top_k=2, num_classes=3)
+    assert float(res) == 1.0
+    res1 = accuracy(jnp.asarray(preds), jnp.asarray(target), top_k=1, num_classes=3)
+    assert float(res1) == 0.5
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+def test_accuracy_average_multiclass(average):
+    from sklearn.metrics import recall_score
+
+    import jax.numpy as jnp
+
+    preds = _multiclass_prob_inputs.preds[0]
+    target = _multiclass_inputs.target[0]
+    res = accuracy(
+        jnp.asarray(preds), jnp.asarray(target), average=average, num_classes=NUM_CLASSES
+    )
+    sk_avg = {"macro": "macro", "weighted": "weighted", "none": None}[average]
+    # accuracy with class-averaging == per-class recall averaged
+    expected = recall_score(target, preds.argmax(-1), average=sk_avg, zero_division=0)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+def test_accuracy_ignore_index():
+    import jax.numpy as jnp
+
+    preds = np.asarray([0, 1, 1, 2, 2])
+    target = np.asarray([0, 1, 2, 1, 2])
+    res = accuracy(jnp.asarray(preds), jnp.asarray(target), ignore_index=0, num_classes=3)
+    # class 0 column dropped: rows evaluated on classes {1,2} one-hot
+    expected = sk_accuracy(target[1:], preds[1:])
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+def test_accuracy_invalid_args():
+    with pytest.raises(ValueError, match="`average`"):
+        Accuracy(average="wrong")
+    with pytest.raises(ValueError, match="number of classes"):
+        Accuracy(average="macro")
+    with pytest.raises(ValueError, match="top_k"):
+        Accuracy(top_k=0)

@@ -1,0 +1,36 @@
+"""Test rig: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+This is the TPU translation of the reference's gloo-on-localhost trick
+(reference ``tests/unittests/helpers/testers.py:49-61``): `shard_map`/`pjit`
+collectives run unmodified over 8 fake devices, so the distributed sync path
+gets real coverage in CI without TPU hardware (SURVEY.md §4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# the container's sitecustomize force-registers the TPU backend; override it
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+assert len(jax.devices()) >= 8, "test rig needs the 8-device virtual CPU platform"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(42)
+    yield
