@@ -1,0 +1,134 @@
+"""Binned (constant-memory) curve metrics — the XLA-native curve design.
+
+Parity target: ``/root/reference/src/torchmetrics/classification/binned_precision_recall.py:45,182,233``.
+
+Where the exact curve metrics hold the whole dataset in list states and sweep
+unique thresholds on host, these keep fixed-shape ``(C, T)`` TP/FP/FN counters
+updated with one vectorized broadcast per batch — fully jit-compiled, constant
+memory, sum-reducible across devices.  SURVEY.md §7 calls this "the natural
+fixed-shape design for XLA"; the reference's threshold loop
+(``binned_precision_recall.py:161-165``) becomes a single ``(N, C, T)``
+broadcast reduction on the VPU.
+"""
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute_with_precision_recall,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import to_onehot
+
+Array = jax.Array
+
+METRIC_EPS = 1e-6
+
+
+class BinnedPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Array, List[float]] = 100,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        if isinstance(thresholds, int):
+            self.num_thresholds = thresholds
+            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+        elif thresholds is not None:
+            if not isinstance(thresholds, (list, jax.Array)):
+                raise ValueError(
+                    "Expected argument `thresholds` to either be an integer, list of floats or a tensor"
+                )
+            self.thresholds = jnp.asarray(thresholds)
+            self.num_thresholds = self.thresholds.size
+        for name in ("TPs", "FPs", "FNs"):
+            self.add_state(
+                name,
+                default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if preds.ndim == target.ndim == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        if preds.ndim == target.ndim + 1:
+            target = to_onehot(target, num_classes=self.num_classes)
+        target = target == 1
+        # one vectorized (N, C, T) broadcast instead of a threshold loop
+        predictions = preds[:, :, None] >= self.thresholds[None, None, :]
+        t = target[:, :, None]
+        self.TPs = self.TPs + jnp.sum(t & predictions, axis=0)
+        self.FPs = self.FPs + jnp.sum((~t) & predictions, axis=0)
+        self.FNs = self.FNs + jnp.sum(t & (~predictions), axis=0)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """precision/recall per threshold with the (1, 0) end point appended."""
+        precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
+        recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
+        t_ones = jnp.ones((self.num_classes, 1), dtype=precisions.dtype)
+        precisions = jnp.concatenate([precisions, t_ones], axis=1)
+        t_zeros = jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)
+        recalls = jnp.concatenate([recalls, t_zeros], axis=1)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
+
+class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
+    higher_is_better = True
+
+    def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
+        precisions, recalls, _ = super().compute()
+        return _average_precision_compute_with_precision_recall(
+            precisions, recalls, self.num_classes, average=None
+        )
+
+
+class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
+    higher_is_better = True
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Union[int, Array, List[float]] = 100,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, **kwargs)
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        """Max recall with precision >= min_precision; threshold 1e6 if none."""
+        precisions, recalls, thresholds = super().compute()
+        if self.num_classes == 1:
+            precisions = jnp.stack([precisions])
+            recalls = jnp.stack([recalls])
+            thresholds = [thresholds]
+        else:
+            precisions = jnp.stack(precisions)
+            recalls = jnp.stack(recalls)
+        thresholds_padded = jnp.concatenate(
+            [thresholds[0], jnp.asarray([1e6], dtype=thresholds[0].dtype)]
+        )
+        condition = precisions >= self.min_precision
+        masked_recalls = jnp.where(condition, recalls, 0.0)
+        best = jnp.argmax(masked_recalls, axis=1)
+        max_recall = jnp.take_along_axis(masked_recalls, best[:, None], axis=1)[:, 0]
+        best_thresholds = jnp.where(
+            max_recall == 0, 1e6, thresholds_padded[jnp.minimum(best, thresholds_padded.size - 1)]
+        )
+        if self.num_classes == 1:
+            return max_recall[0], best_thresholds[0]
+        return max_recall, best_thresholds

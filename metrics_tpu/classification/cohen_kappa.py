@@ -1,0 +1,51 @@
+"""CohenKappa module metric
+(reference ``/root/reference/src/torchmetrics/classification/cohen_kappa.py:23``)."""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.cohen_kappa import (
+    _cohen_kappa_compute,
+    _cohen_kappa_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CohenKappa(Metric):
+    """Cohen's kappa inter-annotator agreement over a streamed confusion matrix."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        weights: Optional[str] = None,
+        threshold: float = 0.5,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.weights = weights
+        self.threshold = threshold
+        self.validate_args = validate_args
+        if weights not in (None, "linear", "quadratic"):
+            raise ValueError("Argument weights needs to be None, 'linear' or 'quadratic'")
+        self.add_state(
+            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum"
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _cohen_kappa_update(
+            preds, target, self.num_classes, self.threshold, validate_args=self.validate_args
+        )
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _cohen_kappa_compute(self.confmat, self.weights)

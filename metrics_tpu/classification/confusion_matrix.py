@@ -1,0 +1,58 @@
+"""ConfusionMatrix module metric
+(reference ``/root/reference/src/torchmetrics/classification/confusion_matrix.py:23``)."""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ConfusionMatrix(Metric):
+    """Streaming (C, C) confusion counts — the shared state of the
+    CohenKappa / JaccardIndex / MatthewsCorrCoef compute group."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.threshold = threshold
+        self.multilabel = multilabel
+        self.validate_args = validate_args
+        allowed_normalize = ("true", "pred", "all", "none", None)
+        if normalize not in allowed_normalize:
+            raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+        default = (
+            jnp.zeros((num_classes, 2, 2), dtype=jnp.int32)
+            if multilabel
+            else jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
+        )
+        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _confusion_matrix_update(
+            preds, target, self.num_classes, self.threshold, self.multilabel, self.validate_args
+        )
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _confusion_matrix_compute(self.confmat, self.normalize)

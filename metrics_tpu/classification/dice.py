@@ -1,0 +1,22 @@
+"""Dice module metric (reference ``/root/reference/src/torchmetrics/classification/dice.py:23``)."""
+
+from typing import Any
+
+import jax
+
+from metrics_tpu.classification.precision_recall import _PrecisionRecallBase
+from metrics_tpu.functional.classification.dice import _dice_compute
+
+Array = jax.Array
+
+
+class Dice(_PrecisionRecallBase):
+    """Dice = 2*tp / (2*tp + fp + fn)."""
+
+    def __init__(self, zero_division: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_division = zero_division
+
+    def compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _dice_compute(tp, fp, fn, self.average, self.mdmc_reduce, self.zero_division)

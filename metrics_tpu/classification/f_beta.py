@@ -1,0 +1,32 @@
+"""FBetaScore / F1Score module metrics
+(reference ``/root/reference/src/torchmetrics/classification/f_beta.py:23,163``)."""
+
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.precision_recall import _PrecisionRecallBase
+from metrics_tpu.functional.classification.f_beta import _fbeta_compute
+
+Array = jax.Array
+
+
+class FBetaScore(_PrecisionRecallBase):
+    """Weighted harmonic mean of precision and recall."""
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.beta = beta
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _fbeta_compute(
+            tp, fp, tn, fn, self.beta, self.ignore_index, self.average, self.mdmc_reduce
+        )
+
+
+class F1Score(FBetaScore):
+    """F-beta with beta=1 (reference ``f_beta.py:163``)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(beta=1.0, **kwargs)

@@ -1,0 +1,40 @@
+"""HammingDistance module metric
+(reference ``/root/reference/src/torchmetrics/classification/hamming.py:23``)."""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class HammingDistance(Metric):
+    """Fraction of wrong labels across all predictions (lower is better)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, threshold: float = 0.5, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.threshold = threshold
+        self.validate_args = validate_args
+        self.add_state("correct", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(
+            preds, target, self.threshold, validate_args=self.validate_args
+        )
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

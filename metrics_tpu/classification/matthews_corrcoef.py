@@ -1,0 +1,47 @@
+"""MatthewsCorrCoef module metric
+(reference ``/root/reference/src/torchmetrics/classification/matthews_corrcoef.py:26``)."""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MatthewsCorrCoef(Metric):
+    """Matthews correlation coefficient over a streamed confusion matrix."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        threshold: float = 0.5,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.validate_args = validate_args
+        self.add_state(
+            "confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum"
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        confmat = _matthews_corrcoef_update(
+            preds, target, self.num_classes, self.threshold, validate_args=self.validate_args
+        )
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_compute(self.confmat)

@@ -1,0 +1,64 @@
+"""Precision / Recall module metrics
+(reference ``/root/reference/src/torchmetrics/classification/precision_recall.py:23,162``)."""
+
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.precision_recall import (
+    _precision_compute,
+    _recall_compute,
+)
+
+Array = jax.Array
+
+
+class _PrecisionRecallBase(StatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        super().__init__(
+            reduce="macro" if average in ("weighted", "none", None) else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+
+
+class Precision(_PrecisionRecallBase):
+    """Precision = tp / (tp + fp) (reference ``precision_recall.py:23``)."""
+
+    def compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _precision_compute(tp, fp, fn, self.average, self.mdmc_reduce)
+
+
+class Recall(_PrecisionRecallBase):
+    """Recall = tp / (tp + fn) (reference ``precision_recall.py:162``)."""
+
+    def compute(self) -> Array:
+        tp, fp, _, fn = self._get_final_stats()
+        return _recall_compute(tp, fp, fn, self.average, self.mdmc_reduce)

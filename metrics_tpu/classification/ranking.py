@@ -1,0 +1,67 @@
+"""Multilabel ranking module metrics
+(reference ``/root/reference/src/torchmetrics/classification/ranking.py:30,85,142``)."""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.ranking import (
+    _coverage_error_compute,
+    _coverage_error_update,
+    _label_ranking_average_precision_compute,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_compute,
+    _label_ranking_loss_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _RankingBase(Metric):
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        # accumulated sample weight; equals `total` when no weights are given,
+        # so compute() can always normalize by it (reference keeps a separate
+        # weight state, ranking.py:56-82)
+        self.add_state("weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _accumulate(self, measure: Array, total: int, weight_sum: Optional[Array]) -> None:
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        self.weight = self.weight + (weight_sum if weight_sum is not None else float(total))
+
+    def compute(self) -> Array:
+        return self.measure / self.weight
+
+
+class CoverageError(_RankingBase):
+    """How far down the ranking we must go to cover all true labels."""
+
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        measure, total, weight_sum = _coverage_error_update(preds, target, sample_weight)
+        self._accumulate(measure, total, weight_sum)
+
+
+class LabelRankingAveragePrecision(_RankingBase):
+    higher_is_better = True
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        measure, total, weight_sum = _label_ranking_average_precision_update(preds, target, sample_weight)
+        self._accumulate(measure, total, weight_sum)
+
+
+class LabelRankingLoss(_RankingBase):
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        measure, total, weight_sum = _label_ranking_loss_update(preds, target, sample_weight)
+        self._accumulate(measure, total, weight_sum)

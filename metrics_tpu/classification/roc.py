@@ -1,0 +1,18 @@
+"""ROC module metric (reference ``/root/reference/src/torchmetrics/classification/roc.py:25``)."""
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
+from metrics_tpu.functional.classification.roc import _roc_compute
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ROC(PrecisionRecallCurve):
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _roc_compute(preds, target, self.num_classes, self.pos_label)

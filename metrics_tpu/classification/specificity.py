@@ -1,0 +1,17 @@
+"""Specificity module metric
+(reference ``/root/reference/src/torchmetrics/classification/specificity.py:24``)."""
+
+import jax
+
+from metrics_tpu.classification.precision_recall import _PrecisionRecallBase
+from metrics_tpu.functional.classification.specificity import _specificity_compute
+
+Array = jax.Array
+
+
+class Specificity(_PrecisionRecallBase):
+    """Specificity = tn / (tn + fp)."""
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _specificity_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

@@ -1,0 +1,300 @@
+"""MetricCollection with compute-group state sharing.
+
+Parity target: ``/root/reference/src/torchmetrics/collections.py`` (the
+``MetricCollection`` class, compute groups at 161-267).
+
+Compute groups: metrics whose streaming states are identical after the first
+update (e.g. Precision/Recall/F1 all sitting on tp/fp/tn/fn, or
+CohenKappa/JaccardIndex/MatthewsCorrCoef on a confusion matrix) are detected
+automatically; afterwards ``update`` runs ONCE per group and the state arrays
+are shared by reference with the other members.  jax arrays are immutable, so
+reference-sharing is safe by construction — no defensive deep-copies needed on
+read access (a genuine simplification over the reference, which must re-copy
+state on ``items()``/``values()``).
+"""
+
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import _flatten_dict, allclose
+
+Array = jax.Array
+
+
+class MetricCollection:
+    """Dict-of-metrics sharing one call interface.
+
+    Args:
+        metrics: a Metric, a sequence of Metrics, or a dict name -> Metric.
+        prefix / postfix: added to every key in the output dict.
+        compute_groups: auto-detect metrics with identical states and update
+            only one representative per group (True by default), or an explicit
+            list of name-groups.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: Dict[str, Metric] = {}
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked = False
+        self._compute_groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    # ------------------------------------------------------------- population
+    def add_metrics(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+    ) -> None:
+        """Add metrics (reference ``collections.py:302-363``)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)  # type: ignore[arg-type]
+            if remain:
+                raise ValueError(
+                    f"You have passes extra arguments {remain} which are not Metric instances."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with mapping input."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = type(metric).__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        if k in self._modules:
+                            raise ValueError(f"Encountered two metrics both named {k}")
+                        self._modules[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        if isinstance(self._enable_compute_groups, list):
+            # explicit groups: validate names, skip auto-detection entirely
+            # (reference collections.py:371-380)
+            self._compute_groups = {i: list(g) for i, g in enumerate(self._enable_compute_groups)}
+            for group in self._compute_groups.values():
+                for name in group:
+                    if name not in self._modules:
+                        raise ValueError(
+                            f"Input {name} in `compute_groups` argument does not match a metric in the collection"
+                        )
+            # metrics not named in any explicit group become singleton groups
+            grouped = {name for g in self._compute_groups.values() for name in g}
+            next_idx = len(self._compute_groups)
+            for name in self._modules:
+                if name not in grouped:
+                    self._compute_groups[next_idx] = [name]
+                    next_idx += 1
+            self._groups_checked = True
+        else:
+            self._compute_groups = {}
+            self._groups_checked = False
+
+    # ------------------------------------------------------------------ calls
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-metric forward; returns {name: batch value} (reference :151-159)."""
+        res = {
+            k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()
+        }
+        # forward ran full updates on every metric; group states are in sync
+        # again only after re-sharing
+        if self._groups_checked:
+            self._share_group_states()
+        return {self._to_key(k): v for k, v in res.items()}
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update once per compute group (reference :161-189)."""
+        if self._groups_checked:
+            for group in self._compute_groups.values():
+                leader = self._modules[group[0]]
+                leader.update(*args, **leader._filter_kwargs(**kwargs))
+            self._share_group_states()
+        else:
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Pairwise-compare metric states; equal states merge into one group
+        (reference ``collections.py:191-249``)."""
+        if not self._compute_groups:
+            self._compute_groups = {i: [name] for i, name in enumerate(self._modules)}
+        n_groups = -1
+        while n_groups != len(self._compute_groups):
+            n_groups = len(self._compute_groups)
+            for cg_idx1, cg_members1 in deepcopy(self._compute_groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._compute_groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._compute_groups[cg_idx1].extend(self._compute_groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+        # renumber
+        self._compute_groups = {i: g for i, g in enumerate(self._compute_groups.values())}
+        self._share_group_states()
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Shape + allclose state identity (reference ``collections.py:226-249``)."""
+        if not metric1._defaults or not metric2._defaults:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            s1, s2 = metric1._state[key], metric2._state[key]
+            if type(s1) != type(s2):  # noqa: E721
+                return False
+            if isinstance(s1, list):
+                if len(s1) != len(s2):
+                    return False
+                if not all(allclose(a, b) for a, b in zip(s1, s2)):
+                    return False
+            else:
+                if not allclose(s1, s2):
+                    return False
+        return True
+
+    def _share_group_states(self) -> None:
+        """Point members at the leader's state arrays (immutable → safe)."""
+        for group in self._compute_groups.values():
+            leader = self._modules[group[0]]
+            for name in group[1:]:
+                member = self._modules[name]
+                for key in member._defaults:
+                    member._state[key] = leader._state[key]
+                member._update_count = leader._update_count
+                member._computed = None
+
+    def compute(self) -> Dict[str, Any]:
+        res = {k: m.compute() for k, m in self._modules.items()}
+        res = _flatten_dict(res)
+        return {self._to_key(k): v for k, v in res.items()}
+
+    def reset(self) -> None:
+        for m in self._modules.values():
+            m.reset()
+        if self._groups_checked:
+            self._share_group_states()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            for k, v in m.state_dict().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        per_metric: Dict[str, Dict[str, Any]] = {}
+        for key, value in state_dict.items():
+            name, _, state_key = key.partition(".")
+            per_metric.setdefault(name, {})[state_key] = value
+        for name, states in per_metric.items():
+            self._modules[name].load_state_dict(states)
+
+    # ------------------------------------------------------------- dict sugar
+    def _to_key(self, base: str) -> str:
+        if self.prefix:
+            base = self.prefix + base
+        if self.postfix:
+            base = base + self.postfix
+        return base
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._modules.keys()
+        return [self._to_key(k) for k in self._modules]
+
+    def values(self) -> Iterable[Metric]:
+        return self._modules.values()
+
+    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:
+        if keep_base:
+            return self._modules.items()
+        return [(self._to_key(k), v) for k, v in self._modules.items()]
+
+    def __getitem__(self, key: str) -> Metric:
+        return self._modules[key]
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._compute_groups
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n"
+        for name, m in self._modules.items():
+            repr_str += f"  ({name}): {m!r}\n"
+        return repr_str + ")"

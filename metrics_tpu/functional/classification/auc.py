@@ -1,0 +1,49 @@
+"""AUC (trapezoidal area under any x/y curve)
+(reference ``functional/classification/auc.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim > 1:
+        x = x.squeeze()
+    if y.ndim > 1:
+        y = y.squeeze()
+    if x.ndim > 1 or y.ndim > 1 or x.shape != y.shape:
+        raise ValueError(
+            f"Expected both `x` and `y` to be 1d of the same size, got {x.shape} and {y.shape}"
+        )
+    return x, y
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float) -> Array:
+    return jnp.trapezoid(y, x) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    dx = jnp.diff(x)
+    # direction is data-dependent: resolve with where() so this stays jittable
+    any_neg = jnp.any(dx < 0)
+    all_nonpos = jnp.all(dx <= 0)
+    direction = jnp.where(any_neg, jnp.where(all_nonpos, -1.0, jnp.nan), 1.0)
+    if not isinstance(direction, jax.core.Tracer) and jnp.isnan(direction):
+        raise ValueError(
+            "The `x` array is neither increasing or decreasing. Try setting the reorder argument to `True`."
+        )
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve y(x) by the trapezoidal rule."""
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

@@ -1,0 +1,139 @@
+"""AUROC functional (reference ``functional/classification/auroc.py``)."""
+
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.classification.auc import _auc_compute_without_check
+from metrics_tpu.functional.classification.roc import roc
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, DataType
+
+Array = jax.Array
+
+
+def _auroc_update(preds: Array, target: Array) -> Tuple[Array, Array, DataType]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _, _, mode = _input_format_classification(preds, target, validate_args=False)
+    if mode == DataType.MULTIDIM_MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating):
+        # move class dim last and flatten the extra dims into N
+        n_classes = preds.shape[1]
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, n_classes)
+        target = target.reshape(-1)
+    if mode == DataType.MULTILABEL and preds.ndim > 2:
+        n_classes = preds.shape[1]
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, n_classes)
+        target = jnp.moveaxis(target, 1, -1).reshape(-1, n_classes)
+    return preds, target, mode
+
+
+def _auroc_compute(
+    preds: Array,
+    target: Array,
+    mode: DataType,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Array:
+    if mode == DataType.BINARY:
+        num_classes = 1
+
+    if max_fpr is not None:
+        if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+        if mode != DataType.BINARY:
+            raise ValueError(
+                "Partial AUC computation not available in multilabel/multiclass setting,"
+                f" 'max_fpr' must be set to `None`, received `{max_fpr}`."
+            )
+
+    if mode == DataType.MULTILABEL:
+        if average == AverageMethod.MICRO:
+            fpr, tpr, _ = roc(preds.reshape(-1), target.reshape(-1), 1, pos_label, sample_weights)
+        elif num_classes:
+            output = [
+                roc(preds[:, i], target[:, i], num_classes=1, pos_label=1, sample_weights=sample_weights)
+                for i in range(num_classes)
+            ]
+            fpr = [o[0] for o in output]
+            tpr = [o[1] for o in output]
+        else:
+            raise ValueError("Detected input to be `multilabel` but you did not provide `num_classes` argument")
+    else:
+        if mode != DataType.BINARY:
+            if num_classes is None:
+                raise ValueError("Detected input to `multiclass` but you did not provide `num_classes` argument")
+            target_np = np.asarray(target).astype(np.int64)
+            if average == AverageMethod.WEIGHTED and len(np.unique(target_np)) < num_classes:
+                # classes with zero observations are excluded (their weight is 0)
+                observed = np.bincount(target_np, minlength=num_classes) > 0
+                for c in range(num_classes):
+                    if not observed[c]:
+                        warnings.warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
+                preds = jnp.asarray(np.asarray(preds)[:, observed])
+                remap = np.cumsum(observed) - 1
+                target = jnp.asarray(remap[target_np])
+                num_classes = int(observed.sum())
+                if num_classes == 1:
+                    raise ValueError("Found 1 non-empty class in `multiclass` AUROC calculation")
+        fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
+
+    if max_fpr is None or max_fpr == 1:
+        if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
+            pass
+        elif num_classes != 1:
+            auc_scores = [_auc_compute_without_check(x, y, 1.0) for x, y in zip(fpr, tpr)]
+            if average == AverageMethod.NONE:
+                return jnp.stack(auc_scores)
+            if average == AverageMethod.MACRO:
+                return jnp.mean(jnp.stack(auc_scores))
+            if average == AverageMethod.WEIGHTED:
+                if mode == DataType.MULTILABEL:
+                    support = jnp.sum(target, axis=0).astype(jnp.float32)
+                else:
+                    support = jnp.bincount(
+                        jnp.asarray(target).reshape(-1).astype(jnp.int32), length=num_classes
+                    ).astype(jnp.float32)
+                return jnp.sum(jnp.stack(auc_scores) * support / jnp.sum(support))
+            allowed_average = ("none", "macro", "weighted")
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+        return _auc_compute_without_check(fpr, tpr, 1.0)
+
+    # partial AUC over [0, max_fpr] with McClish standardization
+    fpr_np = np.asarray(fpr, dtype=np.float64)
+    tpr_np = np.asarray(tpr, dtype=np.float64)
+    stop = int(np.searchsorted(fpr_np, max_fpr, side="right"))
+    weight = (max_fpr - fpr_np[stop - 1]) / (fpr_np[stop] - fpr_np[stop - 1])
+    interp_tpr = tpr_np[stop - 1] + weight * (tpr_np[stop] - tpr_np[stop - 1])
+    tpr_np = np.concatenate([tpr_np[:stop], [interp_tpr]])
+    fpr_np = np.concatenate([fpr_np[:stop], [max_fpr]])
+    partial_auc = np.trapezoid(tpr_np, fpr_np)
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return jnp.asarray(
+        0.5 * (1 + (partial_auc - min_area) / (max_area - min_area)), dtype=jnp.float32
+    )
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Array:
+    """Area under the ROC curve."""
+    preds, target, mode = _auroc_update(preds, target)
+    return _auroc_compute(
+        preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights
+    )

@@ -1,0 +1,105 @@
+"""Average precision functional
+(reference ``functional/classification/average_precision.py``)."""
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _average_precision_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Tuple[Array, Array, int, Optional[int]]:
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(
+        preds, target, num_classes, pos_label
+    )
+    if average == "micro" and preds.ndim != target.ndim:
+        raise ValueError("Cannot use `micro` average with multi-class input")
+    return preds, target, num_classes, pos_label
+
+
+def _average_precision_compute_with_precision_recall(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Union[List[Array], Array]:
+    """Step-function integral -sum((r[i+1]-r[i]) * p[i]) per class + averaging."""
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+
+    if average in ("macro", "weighted"):
+        res_t = jnp.stack(res)
+        if average == "macro" or (weights is not None and bool(jnp.isclose(jnp.sum(weights), 0.0))):
+            has_nan = bool(jnp.any(jnp.isnan(res_t)))
+            if has_nan:
+                rank_zero_warn(
+                    "Average precision score for one or more classes was `nan`. Ignoring these classes in macro-average",
+                    UserWarning,
+                )
+            return jnp.nanmean(res_t) if has_nan else jnp.mean(res_t)
+        weights = weights / jnp.sum(weights)
+        return jnp.sum(res_t * weights)
+    if average in (None, "none"):
+        return res
+    raise ValueError(f"Received an incompatible combinations of inputs to make reduction with average={average}")
+
+
+def _average_precision_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    if average == "micro" and preds.ndim == target.ndim:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+        num_classes = 1
+    if average == "weighted":
+        if preds.ndim == target.ndim and target.ndim > 1:
+            weights = jnp.sum(target, axis=0).astype(jnp.float32)
+        else:
+            weights = jnp.bincount(jnp.asarray(target).astype(jnp.int32), length=num_classes).astype(
+                jnp.float32
+            )
+    else:
+        weights = None
+    precision, recall, _ = _precision_recall_curve_compute(
+        preds, target, num_classes, pos_label, sample_weights
+    )
+    return _average_precision_compute_with_precision_recall(
+        precision, recall, num_classes, average, weights
+    )
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """Area under the precision-recall step curve."""
+    preds, target, num_classes, pos_label = _average_precision_update(
+        preds, target, num_classes, pos_label, average
+    )
+    return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

@@ -1,0 +1,88 @@
+"""Calibration error functional
+(reference ``functional/classification/calibration_error.py``).
+
+The bucketize+scatter binning becomes a one-hot segment reduction (matmul
+style), which XLA lowers deterministically on TPU.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+
+Array = jax.Array
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array
+) -> Tuple[Array, Array, Array]:
+    """Per-bin mean accuracy, mean confidence, and sample proportion."""
+    n_bins = bin_boundaries.size - 1
+    indices = jnp.clip(
+        jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1
+    )
+    one_hot = jax.nn.one_hot(indices, n_bins, dtype=confidences.dtype)  # (N, B)
+    count_bin = jnp.sum(one_hot, axis=0)
+    conf_bin = jnp.where(count_bin > 0, (confidences @ one_hot) / jnp.maximum(count_bin, 1), 0.0)
+    acc_bin = jnp.where(count_bin > 0, (accuracies.astype(confidences.dtype) @ one_hot) / jnp.maximum(count_bin, 1), 0.0)
+    prop_bin = count_bin / jnp.sum(count_bin)
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Array,
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum(jnp.power(acc_bin - conf_bin, 2) * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.size - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence + correctness per sample."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _, _, mode = _input_format_classification(preds, target, validate_args=False)
+
+    if mode == DataType.BINARY:
+        confidences, accuracies = preds, target
+    elif mode == DataType.MULTICLASS:
+        confidences = jnp.max(preds, axis=1)
+        predictions = jnp.argmax(preds, axis=1)
+        accuracies = predictions == target
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        flat = jnp.moveaxis(preds, 1, -1).reshape(-1, preds.shape[1])
+        confidences = jnp.max(flat, axis=1)
+        predictions = jnp.argmax(flat, axis=1)
+        accuracies = predictions == target.reshape(-1)
+    else:
+        raise ValueError(
+            f"Calibration error is not well-defined for data with size {preds.shape} and targets {target.shape}."
+        )
+    return confidences.astype(jnp.float32), accuracies.astype(jnp.float32)
+
+
+def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
+    """Expected/max/RMS calibration error over equal-width confidence bins."""
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    if not isinstance(n_bins, int) or n_bins <= 0:
+        raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+    confidences, accuracies = _ce_update(preds, target)
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    return _ce_compute(confidences, accuracies, bin_boundaries, norm=norm)

@@ -1,0 +1,171 @@
+"""Precision-recall curve functional.
+
+Parity target: ``/root/reference/src/torchmetrics/functional/classification/precision_recall_curve.py``.
+
+Design note (SURVEY.md §7 delta 2): the exact curve has data-dependent output
+length (unique thresholds), which XLA cannot express — like the reference
+(whose compute is eager torch), the *compute* step runs on host numpy once per
+epoch, while the streamed sample state lives on device.  The constant-memory,
+fully-jittable alternative is ``BinnedPrecisionRecallCurve``.
+"""
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds: np.ndarray,
+    target: np.ndarray,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cumulative fps/tps at each distinct threshold, ascending score order
+    reversed (the standard sklearn-style sweep)."""
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc = np.argsort(preds, kind="stable")[::-1]
+    preds = preds[desc]
+    target = target[desc]
+    weight = 1.0
+    if sample_weights is not None:
+        weight = np.asarray(sample_weights, dtype=np.float64)[desc]
+
+    distinct_idx = np.nonzero(np.diff(preds))[0]
+    threshold_idxs = np.concatenate([distinct_idx, [target.size - 1]])
+    target = (target == pos_label).astype(np.int64)
+    tps = np.cumsum(target * weight)[threshold_idxs]
+    if sample_weights is not None:
+        fps = np.cumsum((1 - target) * weight)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Format inputs: binary flattens; multilabel/multiclass reshape so the
+    class dim is last-flattened (reference contract)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} but detected"
+                    f" {preds.shape[1]} number of classes from predictions"
+                )
+            preds = jnp.moveaxis(preds, 0, 1).reshape(num_classes, -1).T
+            target = jnp.moveaxis(target, 0, 1).reshape(num_classes, -1).T
+        else:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+    elif preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                "Argument `pos_label` should be `None` when running multiclass"
+                f" precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} but detected"
+                f" {preds.shape[1]} number of classes from predictions"
+            )
+        preds = jnp.moveaxis(preds, 0, 1).reshape(num_classes, -1).T
+        target = target.reshape(-1)
+    else:
+        raise ValueError(
+            "preds and target must have same number of dimensions, or one additional dimension for preds"
+        )
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute_single_class(
+    preds: np.ndarray,
+    target: np.ndarray,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        precision = tps / (tps + fps)
+        recall = tps / tps[-1] if tps[-1] > 0 else np.full_like(tps, np.nan, dtype=np.float64)
+
+    # stop when full recall attained; reverse so recall is decreasing
+    last_ind = int(np.flatnonzero(tps == tps[-1])[0]) if tps.size else 0
+    sl = slice(0, last_ind + 1)
+    precision = np.concatenate([precision[sl][::-1], [1.0]])
+    recall = np.concatenate([recall[sl][::-1], [0.0]])
+    thresholds = np.ascontiguousarray(thresholds[sl][::-1])
+    return (
+        jnp.asarray(precision, dtype=jnp.float32),
+        jnp.asarray(recall, dtype=jnp.float32),
+        jnp.asarray(thresholds),
+    )
+
+
+def _precision_recall_curve_compute_multi_class(
+    preds: np.ndarray,
+    target: np.ndarray,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    precision, recall, thresholds = [], [], []
+    for cls in range(num_classes):
+        if target.ndim > 1:
+            res = _precision_recall_curve_compute_single_class(
+                preds[:, cls], target[:, cls], pos_label=1, sample_weights=sample_weights
+            )
+        else:
+            res = _precision_recall_curve_compute_single_class(
+                preds[:, cls], target, pos_label=cls, sample_weights=sample_weights
+            )
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if num_classes == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _precision_recall_curve_compute_single_class(
+            preds_np, target_np, pos_label, sample_weights
+        )
+    return _precision_recall_curve_compute_multi_class(preds_np, target_np, num_classes, sample_weights)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+):
+    """precision, recall, thresholds at every distinct score."""
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(
+        preds, target, num_classes, pos_label
+    )
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,136 @@
+"""Multilabel ranking functionals
+(reference ``functional/classification/ranking.py``).
+
+The reference loops samples in Python for LRAP; here everything is a
+vectorized ``(N, L, L)`` comparison reduction — per-sample Python loops would
+serialize on TPU.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_ranking_input(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+    if preds.ndim != 2 or target.ndim != 2:
+        raise ValueError(
+            "Expected both predictions and target to matrices of shape `[N,C]`"
+            f" but got {preds.ndim} and {target.ndim}"
+        )
+    if preds.shape != target.shape:
+        raise ValueError("Expected both predictions and target to have same shape")
+    if sample_weight is not None:
+        if sample_weight.ndim != 1 or sample_weight.shape[0] != preds.shape[0]:
+            raise ValueError(
+                "Expected sample weights to be 1 dimensional and have same size"
+                f" as the first dimension of preds and target but got {sample_weight.shape}"
+            )
+
+
+def _coverage_error_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_ranking_input(preds, target, sample_weight)
+    # min score among true labels, then count of scores >= that per sample
+    big = jnp.abs(jnp.min(preds)) + 10
+    preds_mod = preds + jnp.where(target == 0, big, 0.0)
+    preds_min = jnp.min(preds_mod, axis=1)
+    coverage = jnp.sum(preds >= preds_min[:, None], axis=1).astype(jnp.float32)
+    n = coverage.size
+    if sample_weight is not None:
+        coverage = coverage * sample_weight
+        sample_weight = jnp.sum(sample_weight)
+    return jnp.sum(coverage), n, sample_weight
+
+
+def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
+    if sample_weight is not None:
+        return jnp.where(sample_weight != 0, coverage / jnp.where(sample_weight == 0, 1.0, sample_weight), coverage / n_elements)
+    return coverage / n_elements
+
+
+def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Average number of top-ranked labels needed to cover all true labels."""
+    coverage, n_elements, sample_weight = _coverage_error_update(preds, target, sample_weight)
+    return _coverage_error_compute(coverage, n_elements, sample_weight)
+
+
+def _label_ranking_average_precision_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_ranking_input(preds, target, sample_weight)
+    n, n_labels = preds.shape
+    relevant = target == 1
+    # tie-aware 'max' ranks via pairwise >= counts (vectorized _rank_data)
+    ge = preds[:, None, :] >= preds[:, :, None]  # ge[i, j, k] = p_ik >= p_ij
+    rank_all = jnp.sum(ge, axis=2).astype(jnp.float32)  # rank among all labels
+    rank_rel = jnp.sum(ge & relevant[:, None, :], axis=2).astype(jnp.float32)
+    n_rel = jnp.sum(relevant, axis=1)
+    per_label = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    score_per_sample = jnp.where(
+        (n_rel > 0) & (n_rel < n_labels),
+        jnp.sum(per_label, axis=1) / jnp.maximum(n_rel, 1),
+        1.0,
+    )
+    if sample_weight is not None:
+        score_per_sample = score_per_sample * sample_weight
+        sample_weight = jnp.sum(sample_weight)
+    return jnp.sum(score_per_sample), n, sample_weight
+
+
+def _label_ranking_average_precision_compute(
+    score: Array, n_elements: int, sample_weight: Optional[Array] = None
+) -> Array:
+    if sample_weight is not None:
+        return jnp.where(sample_weight != 0, score / jnp.where(sample_weight == 0, 1.0, sample_weight), score / n_elements)
+    return score / n_elements
+
+
+def label_ranking_average_precision(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Array:
+    """Mean fraction of relevant labels ranked above each relevant label."""
+    score, n, sample_weight = _label_ranking_average_precision_update(preds, target, sample_weight)
+    return _label_ranking_average_precision_compute(score, n, sample_weight)
+
+
+def _label_ranking_loss_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_ranking_input(preds, target, sample_weight)
+    n, n_labels = preds.shape
+    relevant = target == 1
+    n_relevant = jnp.sum(relevant, axis=1)
+    valid = (n_relevant > 0) & (n_relevant < n_labels)
+
+    inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
+    per_label_loss = ((n_labels - inverse) * relevant).astype(jnp.float32)
+    correction = 0.5 * n_relevant * (n_relevant + 1)
+    denom = n_relevant * (n_labels - n_relevant)
+    loss = (jnp.sum(per_label_loss, axis=1) - correction) / jnp.maximum(denom, 1)
+    loss = jnp.where(valid, loss, 0.0)
+    if sample_weight is not None:
+        loss = loss * sample_weight
+        sample_weight = jnp.sum(sample_weight)
+    return jnp.sum(loss), n, sample_weight
+
+
+def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
+    if sample_weight is not None:
+        return jnp.where(sample_weight != 0, loss / jnp.where(sample_weight == 0, 1.0, sample_weight), loss / n_elements)
+    return loss / n_elements
+
+
+def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Average fraction of incorrectly ordered (relevant, irrelevant) label pairs."""
+    loss, n, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
+    return _label_ranking_loss_compute(loss, n, sample_weight)

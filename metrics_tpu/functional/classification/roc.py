@@ -1,0 +1,89 @@
+"""ROC curve functional (reference ``functional/classification/roc.py``)."""
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+
+Array = jax.Array
+
+_roc_update = _precision_recall_curve_update
+
+
+def _roc_compute_single_class(
+    preds: np.ndarray,
+    target: np.ndarray,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    # prepend the (0, 0) operating point with threshold max+1
+    tps = np.concatenate([[0.0], tps])
+    fps = np.concatenate([[0.0], fps])
+    thresholds = np.concatenate([[thresholds[0] + 1], thresholds]) if thresholds.size else np.asarray([1.0])
+
+    if fps[-1] <= 0:
+        fpr = np.full_like(thresholds, np.nan, dtype=np.float64)
+    else:
+        fpr = fps / fps[-1]
+    if tps[-1] <= 0:
+        tpr = np.full_like(thresholds, np.nan, dtype=np.float64)
+    else:
+        tpr = tps / tps[-1]
+    return (
+        jnp.asarray(fpr, dtype=jnp.float32),
+        jnp.asarray(tpr, dtype=jnp.float32),
+        jnp.asarray(thresholds),
+    )
+
+
+def _roc_compute_multi_class(
+    preds: np.ndarray,
+    target: np.ndarray,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    fpr, tpr, thresholds = [], [], []
+    for cls in range(num_classes):
+        if target.ndim > 1:  # multilabel
+            res = _roc_compute_single_class(preds[:, cls], target[:, cls], 1, sample_weights)
+        else:
+            res = _roc_compute_single_class(preds[:, cls], target, cls, sample_weights)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def _roc_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if num_classes == 1 and preds_np.ndim == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _roc_compute_single_class(preds_np, target_np, pos_label, sample_weights)
+    return _roc_compute_multi_class(preds_np, target_np, num_classes, sample_weights)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+):
+    """fpr, tpr, thresholds (per class for multiclass/multilabel)."""
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

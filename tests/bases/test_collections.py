@@ -1,0 +1,158 @@
+"""MetricCollection tests incl. compute groups
+(reference ``tests/unittests/bases/test_collections.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import f1_score as sk_f1, precision_score as sk_p, recall_score as sk_r
+
+from metrics_tpu import (
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    F1Score,
+    JaccardIndex,
+    MetricCollection,
+    Precision,
+    Recall,
+)
+
+from tests.bases.dummies import DummyMetricDiff, DummyMetricSum
+from tests.classification.inputs import _multiclass_prob_inputs as MC
+from tests.helpers.testers import NUM_CLASSES
+
+
+def test_metric_collection_dict_and_list():
+    mc = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    assert set(mc.keys()) == {"DummyMetricSum", "DummyMetricDiff"}
+    mc2 = MetricCollection({"a": DummyMetricSum(), "b": DummyMetricDiff()})
+    assert set(mc2.keys()) == {"a", "b"}
+
+
+def test_duplicate_names_raise():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([DummyMetricSum(), DummyMetricSum()])
+
+
+def test_collection_update_compute():
+    mc = MetricCollection({"sum": DummyMetricSum(), "diff": DummyMetricDiff()})
+    mc.update(2.0)
+    res = mc.compute()
+    assert float(res["sum"]) == 2.0
+    assert float(res["diff"]) == -2.0
+
+
+def test_collection_forward_returns_batch_values():
+    mc = MetricCollection({"sum": DummyMetricSum()})
+    out = mc(3.0)
+    assert float(out["sum"]) == 3.0
+    out = mc(1.0)
+    assert float(out["sum"]) == 1.0
+    assert float(mc.compute()["sum"]) == 4.0
+
+
+def test_prefix_postfix():
+    mc = MetricCollection({"sum": DummyMetricSum()}, prefix="train_", postfix="_metric")
+    mc.update(1.0)
+    assert list(mc.compute().keys()) == ["train_sum_metric"]
+    clone = mc.clone(prefix="val_")
+    clone.update(1.0)
+    assert list(clone.compute().keys()) == ["val_sum_metric"]
+
+
+def test_compute_groups_detection():
+    """Precision/Recall/F1 share tp/fp/tn/fn -> one compute group; ConfusionMatrix
+    family shares confmat -> another (reference ``collections.py:161-267``)."""
+    mc = MetricCollection(
+        {
+            "p": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "r": Recall(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "cm": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "kappa": CohenKappa(num_classes=NUM_CLASSES),
+        }
+    )
+    preds = jnp.asarray(MC.preds[0])
+    target = jnp.asarray(MC.target[0])
+    mc.update(preds, target)
+    groups = {frozenset(g) for g in mc.compute_groups.values()}
+    assert frozenset({"p", "r", "f1"}) in groups
+    assert frozenset({"cm", "kappa"}) in groups
+
+    # second update only touches group leaders; results must still be exact
+    mc.update(jnp.asarray(MC.preds[1]), jnp.asarray(MC.target[1]))
+    res = mc.compute()
+    t = np.concatenate([MC.target[0], MC.target[1]])
+    p = np.concatenate([MC.preds[0], MC.preds[1]]).argmax(-1)
+    np.testing.assert_allclose(res["p"], sk_p(t, p, average="macro", zero_division=0), atol=1e-5)
+    np.testing.assert_allclose(res["r"], sk_r(t, p, average="macro", zero_division=0), atol=1e-5)
+    np.testing.assert_allclose(res["f1"], sk_f1(t, p, average="macro", zero_division=0), atol=1e-5)
+
+
+def test_compute_groups_disabled_same_results():
+    kwargs = {"num_classes": NUM_CLASSES, "average": "macro"}
+    mc_on = MetricCollection({"p": Precision(**kwargs), "r": Recall(**kwargs)}, compute_groups=True)
+    mc_off = MetricCollection({"p": Precision(**kwargs), "r": Recall(**kwargs)}, compute_groups=False)
+    for i in range(3):
+        mc_on.update(jnp.asarray(MC.preds[i]), jnp.asarray(MC.target[i]))
+        mc_off.update(jnp.asarray(MC.preds[i]), jnp.asarray(MC.target[i]))
+    res_on, res_off = mc_on.compute(), mc_off.compute()
+    for k in res_on:
+        np.testing.assert_allclose(np.asarray(res_on[k]), np.asarray(res_off[k]), atol=1e-7)
+    assert len(mc_off.compute_groups) == 0
+
+
+def test_collection_reset():
+    mc = MetricCollection({"sum": DummyMetricSum()})
+    mc.update(5.0)
+    mc.reset()
+    assert float(mc.compute()["sum"]) == 0.0
+
+
+def test_nested_collections():
+    inner = MetricCollection({"sum": DummyMetricSum()})
+    outer = MetricCollection({"inner": inner, "diff": DummyMetricDiff()})
+    outer.update(2.0)
+    res = outer.compute()
+    assert "inner_sum" in res and "diff" in res
+
+
+def test_collection_kwarg_filtering():
+    mc = MetricCollection({"acc": Accuracy(num_classes=NUM_CLASSES, validate_args=False)})
+    # extra kwargs that Accuracy.update doesn't accept must be dropped
+    out = mc(
+        preds=jnp.asarray(MC.preds[0]),
+        target=jnp.asarray(MC.target[0]),
+        something_else=123,
+    )
+    assert "acc" in out
+
+
+def test_explicit_compute_groups_respected():
+    """User-specified groups skip auto-merging and validate names."""
+    mc = MetricCollection(
+        {"a": DummyMetricSum(), "b": DummyMetricSum()},
+        compute_groups=[["a"], ["b"]],
+    )
+    mc.update(1.0)
+    mc.update(2.0)
+    # identical states, but the explicit split must survive
+    groups = {frozenset(g) for g in mc.compute_groups.values()}
+    assert groups == {frozenset({"a"}), frozenset({"b"})}
+    res = mc.compute()
+    assert float(res["a"]) == 3.0 and float(res["b"]) == 3.0
+
+
+def test_explicit_compute_groups_unknown_name_raises():
+    with pytest.raises(ValueError, match="compute_groups"):
+        MetricCollection({"a": DummyMetricSum()}, compute_groups=[["a", "typo"]])
+
+
+def test_explicit_compute_groups_unlisted_metric_still_updates():
+    mc = MetricCollection(
+        {"a": DummyMetricSum(), "b": DummyMetricSum(), "c": DummyMetricDiff()},
+        compute_groups=[["a", "b"]],
+    )
+    mc.update(2.0)
+    res = mc.compute()
+    assert float(res["c"]) == -2.0

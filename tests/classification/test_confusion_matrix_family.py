@@ -1,0 +1,132 @@
+"""ConfusionMatrix / CohenKappa / JaccardIndex / MatthewsCorrCoef vs sklearn."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    cohen_kappa_score as sk_cohen_kappa,
+    confusion_matrix as sk_confusion_matrix,
+    jaccard_score as sk_jaccard,
+    matthews_corrcoef as sk_matthews,
+)
+
+from metrics_tpu.classification import CohenKappa, ConfusionMatrix, JaccardIndex, MatthewsCorrCoef
+from metrics_tpu.functional.classification import cohen_kappa, confusion_matrix, jaccard_index, matthews_corrcoef
+
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+MC = _multiclass_prob_inputs
+
+
+def _hard(p, t):
+    p, t = np.asarray(p), np.asarray(t)
+    if p.dtype.kind == "f":
+        p = p.argmax(axis=1) if p.ndim == t.ndim + 1 else (p >= THRESHOLD).astype(np.int64)
+    return p, t
+
+
+class TestConfusionMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    def test_confmat_multiclass(self, ddp, normalize):
+        def sk_cm(p, t):
+            p, t = _hard(p, t)
+            return sk_confusion_matrix(t, p, labels=list(range(NUM_CLASSES)), normalize=normalize)
+
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=ConfusionMatrix,
+            reference_fn=sk_cm,
+            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+            ddp=ddp,
+            check_batch=(normalize is None) or True,
+        )
+
+    def test_confmat_binary(self):
+        def sk_cm(p, t):
+            p, t = _hard(p, t)
+            return sk_confusion_matrix(t, p, labels=[0, 1])
+
+        self.run_class_metric_test(
+            preds=_binary_prob_inputs.preds,
+            target=_binary_prob_inputs.target,
+            metric_class=ConfusionMatrix,
+            reference_fn=sk_cm,
+            metric_args={"num_classes": 2, "threshold": THRESHOLD},
+        )
+
+    def test_out_of_range_label_raises(self):
+        with pytest.raises(ValueError, match="label"):
+            confusion_matrix(jnp.asarray([0, 1, 2, 0]), jnp.asarray([0, 1, 4, 0]), num_classes=3)
+
+
+class TestCohenKappa(MetricTester):
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_kappa_multiclass(self, weights):
+        def sk_ck(p, t):
+            p, t = _hard(p, t)
+            return sk_cohen_kappa(t, p, weights=weights)
+
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=CohenKappa,
+            reference_fn=sk_ck,
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        )
+
+
+class TestJaccard(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("average", ["macro", "micro", "weighted"])
+    def test_jaccard_multiclass(self, ddp, average):
+        def sk_j(p, t):
+            p, t = _hard(p, t)
+            return sk_jaccard(t, p, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=JaccardIndex,
+            reference_fn=sk_j,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            ddp=ddp,
+        )
+
+    def test_jaccard_absent_score(self):
+        preds = jnp.asarray([0, 0, 1, 1])
+        target = jnp.asarray([0, 0, 1, 1])
+        res = jaccard_index(preds, target, num_classes=3, average="none", absent_score=0.5)
+        np.testing.assert_allclose(np.asarray(res), [1.0, 1.0, 0.5])
+
+
+class TestMatthews(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_matthews_multiclass(self, ddp):
+        def sk_m(p, t):
+            p, t = _hard(p, t)
+            return sk_matthews(t, p)
+
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=MatthewsCorrCoef,
+            reference_fn=sk_m,
+            metric_args={"num_classes": NUM_CLASSES},
+            ddp=ddp,
+        )
+
+    def test_matthews_binary_functional(self):
+        p = jnp.asarray(_binary_prob_inputs.preds[0])
+        t = jnp.asarray(_binary_prob_inputs.target[0])
+        hard = np.asarray(p) >= THRESHOLD
+        expected = sk_matthews(np.asarray(t), hard.astype(int))
+        np.testing.assert_allclose(
+            np.asarray(matthews_corrcoef(p, t, num_classes=2)), expected, atol=1e-5
+        )

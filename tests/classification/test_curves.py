@@ -1,0 +1,201 @@
+"""Curve metrics (ROC / PR-curve / AUROC / AveragePrecision / AUC / binned) vs sklearn."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    average_precision_score as sk_ap,
+    precision_recall_curve as sk_prc,
+    roc_auc_score as sk_auroc,
+    roc_curve as sk_roc,
+)
+
+from metrics_tpu.classification import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional.classification import (
+    auc,
+    auroc,
+    average_precision,
+    precision_recall_curve,
+    roc,
+)
+
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+BIN = _binary_prob_inputs
+MC = _multiclass_prob_inputs
+ML = _multilabel_prob_inputs
+
+
+def test_binary_roc_matches_sklearn():
+    p, t = BIN.preds[0], BIN.target[0]
+    fpr, tpr, thr = roc(jnp.asarray(p), jnp.asarray(t))
+    sk_fpr, sk_tpr, sk_thr = sk_roc(t, p, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def test_binary_prc_matches_sklearn():
+    p, t = BIN.preds[0], BIN.target[0]
+    precision, recall, thr = precision_recall_curve(jnp.asarray(p), jnp.asarray(t))
+    sk_p, sk_r, sk_t = sk_prc(t, p)
+    # the reference truncates the curve once full recall is attained; newer
+    # sklearn keeps the redundant recall==1 points — compare the common suffix
+    k = len(sk_p) - len(np.asarray(precision))
+    assert k >= 0
+    np.testing.assert_allclose(np.asarray(precision), sk_p[k:], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), sk_r[k:], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thr), sk_t[k:], atol=1e-6)
+    assert np.all(sk_r[:k] == 1.0)  # only redundant full-recall points dropped
+
+
+class TestAUROC(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_auroc_class(self, ddp):
+        self.run_class_metric_test(
+            preds=BIN.preds,
+            target=BIN.target,
+            metric_class=AUROC,
+            reference_fn=lambda p, t: sk_auroc(t, p),
+            metric_args={},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_multiclass_auroc_class(self, average):
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=AUROC,
+            reference_fn=lambda p, t: sk_auroc(t, p, multi_class="ovr", average=average, labels=list(range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_multilabel_auroc_fn(self):
+        p, t = ML.preds[0], ML.target[0]
+        res = auroc(jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average="macro")
+        expected = sk_auroc(t, p, average="macro")
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+    def test_max_fpr(self):
+        p, t = BIN.preds[0], BIN.target[0]
+        res = auroc(jnp.asarray(p), jnp.asarray(t), max_fpr=0.5)
+        expected = sk_auroc(t, p, max_fpr=0.5)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+class TestAveragePrecision(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_ap_class(self, ddp):
+        self.run_class_metric_test(
+            preds=BIN.preds,
+            target=BIN.target,
+            metric_class=AveragePrecision,
+            reference_fn=lambda p, t: sk_ap(t, p),
+            metric_args={},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_multiclass_ap(self, average):
+        p, t = MC.preds[0], MC.target[0]
+        res = average_precision(
+            jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average=average
+        )
+        t_oh = np.eye(NUM_CLASSES)[t]
+        expected = sk_ap(t_oh, p, average=average)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+def test_roc_class_multiclass():
+    m = ROC(num_classes=NUM_CLASSES)
+    for i in range(2):
+        m.update(jnp.asarray(MC.preds[i]), jnp.asarray(MC.target[i]))
+    fprs, tprs, thrs = m.compute()
+    assert len(fprs) == NUM_CLASSES
+    t = np.concatenate(MC.target[:2])
+    p = np.concatenate(MC.preds[:2])
+    for c in range(NUM_CLASSES):
+        sk_fpr, sk_tpr, _ = sk_roc((t == c).astype(int), p[:, c], drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fprs[c]), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tprs[c]), sk_tpr, atol=1e-6)
+
+
+def test_prc_class_streaming_binary():
+    m = PrecisionRecallCurve()
+    for i in range(len(BIN.preds)):
+        m.update(jnp.asarray(BIN.preds[i]), jnp.asarray(BIN.target[i]))
+    precision, recall, thr = m.compute()
+    t = np.concatenate(BIN.target)
+    p = np.concatenate(BIN.preds)
+    sk_p, sk_r, _ = sk_prc(t, p)
+    k = len(sk_p) - len(np.asarray(precision))
+    assert k >= 0 and np.all(sk_r[:k] == 1.0)
+    np.testing.assert_allclose(np.asarray(precision), sk_p[k:], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall), sk_r[k:], atol=1e-6)
+
+
+def test_auc_metric():
+    x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+    np.testing.assert_allclose(float(auc(x, y)), 4.0)
+    m = AUC()
+    m.update(x[:2], y[:2])
+    m.update(x[2:], y[2:])
+    np.testing.assert_allclose(float(m.compute()), 4.0)
+
+
+def test_binned_pr_curve_close_to_exact():
+    """Binned precision/recall at threshold t == exact precision/recall at t."""
+    p = np.concatenate(BIN.preds)
+    t = np.concatenate(BIN.target)
+    thresholds = [0.2, 0.5, 0.8]
+    m = BinnedPrecisionRecallCurve(num_classes=1, thresholds=thresholds)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    precisions, recalls, thr = m.compute()
+    for i, th in enumerate(thresholds):
+        hard = p >= th
+        tp = np.sum(hard & (t == 1))
+        fp = np.sum(hard & (t == 0))
+        fn = np.sum(~hard & (t == 1))
+        np.testing.assert_allclose(float(precisions[i]), tp / (tp + fp), atol=1e-4)
+        np.testing.assert_allclose(float(recalls[i]), tp / (tp + fn), atol=1e-4)
+
+
+def test_binned_ap_close_to_exact_ap():
+    p = np.concatenate(BIN.preds)
+    t = np.concatenate(BIN.target)
+    m = BinnedAveragePrecision(num_classes=1, thresholds=500)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    res = m.compute()
+    np.testing.assert_allclose(float(res), sk_ap(t, p), atol=0.01)
+
+
+def test_binned_recall_at_fixed_precision():
+    p = np.asarray([0.1, 0.4, 0.6, 0.85, 0.95], dtype=np.float32)
+    t = np.asarray([0, 0, 1, 1, 1])
+    m = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.99, thresholds=101)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    recall, threshold = m.compute()
+    np.testing.assert_allclose(float(recall), 1.0, atol=1e-5)
+    assert 0.4 < float(threshold) <= 0.6
+
+
+def test_binned_jits():
+    """The binned curve update must run through the jitted path (fixed shapes)."""
+    m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=10)
+    m.update(jnp.asarray(MC.preds[0]), jnp.asarray(MC.target[0]))
+    assert m._jitted_update is not None

@@ -1,0 +1,195 @@
+"""HingeLoss / KLDivergence / CalibrationError / ranking metrics vs oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+from sklearn.metrics import (
+    coverage_error as sk_coverage,
+    hinge_loss as sk_hinge,
+    label_ranking_average_precision_score as sk_lrap,
+    label_ranking_loss as sk_lrl,
+)
+
+from metrics_tpu.classification import (
+    CalibrationError,
+    CoverageError,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)
+from metrics_tpu.functional.classification import (
+    calibration_error,
+    coverage_error,
+    hinge_loss,
+    kl_divergence,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(3)
+N, L = 64, 6
+RANK_PREDS = _rng.random((4, N, L), dtype=np.float32)
+RANK_TARGET = _rng.integers(0, 2, (4, N, L))
+
+
+def test_hinge_binary():
+    t = _rng.integers(0, 2, 100)
+    margins = _rng.normal(size=100).astype(np.float32)
+    res = hinge_loss(jnp.asarray(margins), jnp.asarray(t))
+    expected = sk_hinge(t, margins, labels=[0, 1])
+    np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+
+def test_hinge_multiclass_crammer_singer():
+    t = _rng.integers(0, 4, 100)
+    scores = _rng.normal(size=(100, 4)).astype(np.float32)
+    res = hinge_loss(jnp.asarray(scores), jnp.asarray(t))
+    expected = sk_hinge(t, scores, labels=[0, 1, 2, 3])
+    np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+
+def test_hinge_class_streaming():
+    t = _rng.integers(0, 4, 100)
+    scores = _rng.normal(size=(100, 4)).astype(np.float32)
+    m = HingeLoss()
+    m.update(jnp.asarray(scores[:50]), jnp.asarray(t[:50]))
+    m.update(jnp.asarray(scores[50:]), jnp.asarray(t[50:]))
+    expected = sk_hinge(t, scores, labels=[0, 1, 2, 3])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_hinge_squared_and_one_vs_all():
+    t = _rng.integers(0, 3, 50)
+    scores = _rng.normal(size=(50, 3)).astype(np.float32)
+    res = hinge_loss(jnp.asarray(scores), jnp.asarray(t), squared=True)
+    assert float(res) >= 0
+    res_ova = hinge_loss(jnp.asarray(scores), jnp.asarray(t), multiclass_mode="one-vs-all")
+    assert res_ova.shape == (3,)
+
+
+@pytest.mark.parametrize("log_prob", [False, True])
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_kl_divergence(log_prob, reduction):
+    p = _rng.random((32, 5)).astype(np.float32) + 0.1
+    q = _rng.random((32, 5)).astype(np.float32) + 0.1
+    p /= p.sum(-1, keepdims=True)
+    q /= q.sum(-1, keepdims=True)
+    per_sample = np.asarray([scipy_entropy(p[i], q[i]) for i in range(32)])
+    expected = per_sample.mean() if reduction == "mean" else per_sample.sum()
+    if log_prob:
+        res = kl_divergence(jnp.log(p), jnp.log(q), log_prob=True, reduction=reduction)
+    else:
+        res = kl_divergence(jnp.asarray(p), jnp.asarray(q), reduction=reduction)
+    np.testing.assert_allclose(float(res), expected, atol=1e-4)
+
+
+def test_kl_class_streaming():
+    p = _rng.random((32, 5)).astype(np.float32) + 0.1
+    q = _rng.random((32, 5)).astype(np.float32) + 0.1
+    p /= p.sum(-1, keepdims=True)
+    q /= q.sum(-1, keepdims=True)
+    m = KLDivergence()
+    m.update(jnp.asarray(p[:16]), jnp.asarray(q[:16]))
+    m.update(jnp.asarray(p[16:]), jnp.asarray(q[16:]))
+    expected = np.mean([scipy_entropy(p[i], q[i]) for i in range(32)])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-4)
+
+
+class TestRanking(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize(
+        "metric_class, functional, sk_fn",
+        [
+            (CoverageError, coverage_error, sk_coverage),
+            (LabelRankingAveragePrecision, label_ranking_average_precision, sk_lrap),
+            (LabelRankingLoss, label_ranking_loss, sk_lrl),
+        ],
+    )
+    def test_ranking_class(self, ddp, metric_class, functional, sk_fn):
+        self.run_class_metric_test(
+            preds=RANK_PREDS,
+            target=RANK_TARGET,
+            metric_class=metric_class,
+            reference_fn=lambda p, t: sk_fn(t, p),
+            metric_args={},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize(
+        "functional, sk_fn",
+        [
+            (coverage_error, sk_coverage),
+            (label_ranking_average_precision, sk_lrap),
+            (label_ranking_loss, sk_lrl),
+        ],
+    )
+    def test_ranking_functional(self, functional, sk_fn):
+        self.run_functional_metric_test(
+            RANK_PREDS,
+            RANK_TARGET,
+            metric_functional=functional,
+            reference_fn=lambda p, t: sk_fn(t, p),
+        )
+
+
+def _np_ece(conf, acc, n_bins=15, norm="l1"):
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, conf, side="left") - 1, 0, n_bins - 1)
+    errs, props = [], []
+    for b in range(n_bins):
+        mask = idx == b
+        if mask.sum() == 0:
+            continue
+        errs.append(abs(acc[mask].mean() - conf[mask].mean()))
+        props.append(mask.mean())
+    errs, props = np.asarray(errs), np.asarray(props)
+    if norm == "l1":
+        return np.sum(errs * props)
+    if norm == "max":
+        return np.max(errs)
+    return np.sqrt(np.sum(errs**2 * props))
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_error_multiclass(norm):
+    preds = _rng.random((256, 5)).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    target = _rng.integers(0, 5, 256)
+    conf = preds.max(-1)
+    acc = (preds.argmax(-1) == target).astype(np.float64)
+    res = calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm)
+    np.testing.assert_allclose(float(res), _np_ece(conf, acc, norm=norm), atol=1e-5)
+
+
+def test_calibration_error_class_streaming():
+    preds = _rng.random((256, 5)).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    target = _rng.integers(0, 5, 256)
+    m = CalibrationError()
+    m.update(jnp.asarray(preds[:128]), jnp.asarray(target[:128]))
+    m.update(jnp.asarray(preds[128:]), jnp.asarray(target[128:]))
+    conf = preds.max(-1)
+    acc = (preds.argmax(-1) == target).astype(np.float64)
+    np.testing.assert_allclose(float(m.compute()), _np_ece(conf, acc), atol=1e-5)
+
+
+def test_ranking_sample_weight_streaming():
+    """Weighted streaming must normalize by accumulated weight (not count)."""
+    preds = RANK_PREDS[0]
+    target = RANK_TARGET[0]
+    w = _rng.random(N).astype(np.float32) + 0.5
+    m = CoverageError()
+    m.update(jnp.asarray(preds[: N // 2]), jnp.asarray(target[: N // 2]), jnp.asarray(w[: N // 2]))
+    m.update(jnp.asarray(preds[N // 2 :]), jnp.asarray(target[N // 2 :]), jnp.asarray(w[N // 2 :]))
+    expected = sk_coverage(target, preds, sample_weight=w)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-4)
+
+    m2 = LabelRankingLoss()
+    m2.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(w))
+    np.testing.assert_allclose(
+        float(m2.compute()), sk_lrl(target, preds, sample_weight=w), atol=1e-4
+    )

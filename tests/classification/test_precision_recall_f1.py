@@ -1,0 +1,185 @@
+"""Precision/Recall/F1/FBeta/Specificity tests vs sklearn
+(reference ``tests/unittests/classification/test_precision_recall.py`` etc.)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu.classification import Dice, F1Score, FBetaScore, HammingDistance, Precision, Recall, Specificity
+from metrics_tpu.functional.classification import f1_score, fbeta_score, hamming_distance, precision, recall, specificity
+
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _to_hard(preds, target):
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    if preds.dtype.kind == "f":
+        if preds.ndim == target.ndim:
+            preds = (preds >= THRESHOLD).astype(np.int64)
+        else:
+            preds = preds.argmax(axis=1)
+    return preds, target
+
+
+def _sk_wrapper(sk_fn, average, **kw):
+    def inner(p, t):
+        p, t = _to_hard(p, t)
+        if p.ndim == 2:  # multilabel -> micro over flattened labels for micro avg
+            return sk_fn(t.reshape(-1), p.reshape(-1), average="binary", zero_division=0, **kw)
+        return sk_fn(t, p, average=average, zero_division=0, labels=list(range(NUM_CLASSES)) if average != "binary" else None, **kw)
+
+    return inner
+
+
+MC = _multiclass_prob_inputs
+ML = _multilabel_prob_inputs
+BIN = _binary_prob_inputs
+
+
+class TestPrecisionRecall(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize(
+        "metric_class, functional, sk_fn",
+        [(Precision, precision, sk_precision), (Recall, recall, sk_recall)],
+    )
+    def test_multiclass(self, ddp, average, metric_class, functional, sk_fn):
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=metric_class,
+            reference_fn=_sk_wrapper(sk_fn, average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize(
+        "metric_class, functional, sk_fn",
+        [(Precision, precision, sk_precision), (Recall, recall, sk_recall)],
+    )
+    def test_binary(self, metric_class, functional, sk_fn):
+        self.run_class_metric_test(
+            preds=BIN.preds,
+            target=BIN.target,
+            metric_class=metric_class,
+            reference_fn=_sk_wrapper(sk_fn, "binary"),
+            metric_args={},
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    @pytest.mark.parametrize(
+        "functional, sk_fn", [(precision, sk_precision), (recall, sk_recall)]
+    )
+    def test_functional_multiclass(self, average, functional, sk_fn):
+        self.run_functional_metric_test(
+            MC.preds,
+            MC.target,
+            metric_functional=lambda p, t: functional(p, t, average=average, num_classes=NUM_CLASSES),
+            reference_fn=_sk_wrapper(sk_fn, average),
+        )
+
+
+class TestFBeta(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize("beta", [0.5, 1.0, 2.0])
+    def test_fbeta_multiclass(self, ddp, average, beta):
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=FBetaScore,
+            reference_fn=_sk_wrapper(lambda t, p, **kw: sk_fbeta(t, p, beta=beta, **kw), average),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "beta": beta},
+            ddp=ddp,
+        )
+
+    def test_f1_is_fbeta1(self):
+        p, t = jnp.asarray(MC.preds[0]), jnp.asarray(MC.target[0])
+        np.testing.assert_allclose(
+            np.asarray(f1_score(p, t, num_classes=NUM_CLASSES)),
+            np.asarray(fbeta_score(p, t, beta=1.0, num_classes=NUM_CLASSES)),
+        )
+
+
+class TestSpecificity(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_specificity_multiclass(self, average):
+        def sk_specificity(p, t):
+            # specificity == recall on the negative class, computed per class
+            p, t = _to_hard(p, t)
+            vals = []
+            for c in range(NUM_CLASSES):
+                tn = np.sum((p != c) & (t != c))
+                fp = np.sum((p == c) & (t != c))
+                vals.append((tn, fp))
+            if average == "micro":
+                tn = sum(v[0] for v in vals)
+                fp = sum(v[1] for v in vals)
+                return tn / (tn + fp)
+            return np.mean([tn / (tn + fp) if tn + fp else 0.0 for tn, fp in vals])
+
+        self.run_class_metric_test(
+            preds=MC.preds,
+            target=MC.target,
+            metric_class=Specificity,
+            reference_fn=sk_specificity,
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+            ddp=False,
+        )
+
+
+class TestDiceHamming(MetricTester):
+    def test_dice_micro_equals_f1_micro_style(self):
+        # micro dice on multiclass = micro F1 = accuracy on hard labels
+        preds, target = MC.preds, MC.target
+        self.run_class_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Dice,
+            reference_fn=_sk_wrapper(lambda t, p, **kw: sk_fbeta(t, p, beta=1.0, **kw), "micro"),
+            metric_args={"average": "micro"},
+            ddp=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_hamming_multilabel(self, ddp):
+        def sk_hamming(p, t):
+            p, t = _to_hard(p, t)
+            return np.mean(p.reshape(-1) != t.reshape(-1))
+
+        self.run_class_metric_test(
+            preds=ML.preds,
+            target=ML.target,
+            metric_class=HammingDistance,
+            reference_fn=sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+            ddp=ddp,
+        )
+
+    def test_hamming_functional(self):
+        def sk_hamming(p, t):
+            p, t = _to_hard(p, t)
+            return np.mean(p.reshape(-1) != t.reshape(-1))
+
+        self.run_functional_metric_test(
+            ML.preds, ML.target, metric_functional=hamming_distance, reference_fn=sk_hamming
+        )
+
+
+@pytest.mark.parametrize("average", ["none", None])
+def test_precision_none_returns_per_class(average):
+    p, t = jnp.asarray(MC.preds[0]), jnp.asarray(MC.target[0])
+    res = precision(p, t, average=average, num_classes=NUM_CLASSES)
+    assert res.shape == (NUM_CLASSES,)
+    sk = sk_precision(np.asarray(MC.target[0]), np.asarray(MC.preds[0]).argmax(-1), average=None, zero_division=0)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)

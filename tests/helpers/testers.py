@@ -155,6 +155,37 @@ class MetricTester:
         target_all = jnp.stack([jnp.asarray(target[i]) for i in order])
         mesh = _ddp_mesh()
 
+        host_compute = not metric.jit_compute  # curve-style metrics: host-side compute
+
+        if host_compute:
+            # sync (all-gather) inside shard_map, compute eagerly on the synced
+            # state — mirrors how a user runs a list-state metric over a mesh
+            from metrics_tpu.parallel.backend import AxisBackend
+
+            def run_sync(p_shard: jax.Array, t_shard: jax.Array) -> Any:
+                state = metric.init_state()
+                for i in range(per_dev):
+                    state = metric.apply_update(state, p_shard[i], t_shard[i])
+                synced = metric._sync_state_pure(state, AxisBackend("ddp"))
+                return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], synced)
+
+            fn = jax.shard_map(
+                run_sync, mesh=mesh, in_specs=(P("ddp"), P("ddp")), out_specs=P("ddp"), check_vma=False
+            )
+            synced_state = fn(preds_all, target_all)
+            for r in range(NUM_PROCESSES):
+                m = metric_class(**metric_args)
+                # one eager update locks mode/num_classes attrs, then the
+                # state is replaced wholesale by the synced one
+                m.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+                rank_state = jax.tree_util.tree_map(lambda x: x[r], synced_state)
+                for key, val in rank_state.items():
+                    m._state[key] = val if not isinstance(m._state[key], list) else [val]
+                m._update_count = n_batches
+                m.sync_on_compute = False
+                _assert_allclose(m.compute(), ref_total, atol=atol)
+            return
+
         def run(p_shard: jax.Array, t_shard: jax.Array) -> Any:
             state = metric.init_state()
             for i in range(per_dev):
