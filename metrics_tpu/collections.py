@@ -217,7 +217,12 @@ class MetricCollection:
             for name in group[1:]:
                 member = self._modules[name]
                 for key in member._defaults:
-                    member._state[key] = leader._state[key]
+                    value = leader._state[key]
+                    # arrays are immutable → share by reference; Python lists
+                    # are mutable → shallow-copy so a later full-update pass
+                    # (e.g. after add_metrics re-opens group detection) cannot
+                    # append through an alias into the leader's list
+                    member._state[key] = list(value) if isinstance(value, list) else value
                 member._update_count = leader._update_count
                 member._computed = None
 

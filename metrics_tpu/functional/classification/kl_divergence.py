@@ -35,7 +35,7 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
         return jnp.sum(measures) / total
     if reduction in ("none", None):
         return measures
-    return measures / total
+    raise ValueError(f"Expected reduction to be one of ['mean', 'sum', 'none', None] but got {reduction}")
 
 
 def kl_divergence(

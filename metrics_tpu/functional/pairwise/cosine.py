@@ -1,0 +1,31 @@
+"""Pairwise cosine similarity (reference ``functional/pairwise/cosine.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_cosine_similarity_compute(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diag = _check_input(x, y, zero_diagonal)
+    norm_x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-30)
+    norm_y = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-30)
+    distance = norm_x @ norm_y.T  # one MXU matmul
+    return _zero_diagonal(distance, zero_diag)
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """[N,M] cosine similarity matrix between rows of x and y (default y = x)."""
+    distance = _pairwise_cosine_similarity_compute(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

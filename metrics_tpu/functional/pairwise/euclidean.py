@@ -1,0 +1,34 @@
+"""Pairwise euclidean distance (reference ``functional/pairwise/euclidean.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_euclidean_distance_compute(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diag = _check_input(x, y, zero_diagonal)
+    # ||x-y||² = ||x||² + ||y||² - 2 x·y — the Gram-matrix form keeps the
+    # O(N·M·d) work in a single MXU matmul
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1, keepdims=True)
+    sq = x_norm + y_norm.T - 2 * (x @ y.T)
+    distance = jnp.sqrt(jnp.maximum(sq, 0.0))
+    return _zero_diagonal(distance, zero_diag)
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """[N,M] euclidean distance matrix between rows of x and y (default y = x)."""
+    distance = _pairwise_euclidean_distance_compute(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

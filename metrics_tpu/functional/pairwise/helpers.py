@@ -1,0 +1,48 @@
+"""Shared input checking / reduction for pairwise functionals
+(reference ``functional/pairwise/helpers.py:15-60``)."""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_input(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Tuple[Array, Array, bool]:
+    """Validate [N,d]/[M,d] inputs; default ``zero_diagonal=True`` iff y is x."""
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x.astype(jnp.float32), y.astype(jnp.float32), zero_diagonal
+
+
+def _zero_diagonal(distmat: Array, zero_diagonal: bool) -> Array:
+    if zero_diagonal:
+        n = min(distmat.shape)
+        distmat = distmat.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+    return distmat
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """Reduce an [N,M] matrix along the last dim."""
+    if reduction == "mean":
+        return jnp.mean(distmat, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(distmat, axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")

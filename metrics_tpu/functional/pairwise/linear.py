@@ -1,0 +1,28 @@
+"""Pairwise linear (dot-product) similarity (reference ``functional/pairwise/linear.py``)."""
+
+from typing import Optional
+
+import jax
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_linear_similarity_compute(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diag = _check_input(x, y, zero_diagonal)
+    distance = x @ y.T
+    return _zero_diagonal(distance, zero_diag)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """[N,M] dot-product similarity matrix between rows of x and y (default y = x)."""
+    distance = _pairwise_linear_similarity_compute(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

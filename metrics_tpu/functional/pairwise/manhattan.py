@@ -1,0 +1,29 @@
+"""Pairwise manhattan distance (reference ``functional/pairwise/manhattan.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_manhattan_distance_compute(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diag = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _zero_diagonal(distance, zero_diag)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """[N,M] L1 distance matrix between rows of x and y (default y = x)."""
+    distance = _pairwise_manhattan_distance_compute(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,63 @@
+"""Explained variance (reference ``functional/regression/explained_variance.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+_ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    n_obs = jnp.asarray(preds.shape[0], dtype=jnp.float32)
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    # division-by-zero policy (reference explained_variance.py:83-90), branch-free:
+    # score = 1 when numerator == 0, 0 when only denominator == 0, else 1 - num/den
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    safe_den = jnp.where(nonzero_denominator, denominator, 1.0)
+    output_scores = jnp.where(
+        nonzero_numerator & nonzero_denominator,
+        1.0 - numerator / safe_den,
+        jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, 1.0),
+    )
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        return jnp.sum(denominator / jnp.sum(denominator) * output_scores)
+    raise ValueError(f"Argument `multioutput` must be one of {_ALLOWED_MULTIOUTPUT}, got {multioutput}")
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Explained variance regression score."""
+    stats = _explained_variance_update(jnp.asarray(preds), jnp.asarray(target))
+    return _explained_variance_compute(*stats, multioutput=multioutput)

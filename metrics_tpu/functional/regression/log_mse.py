@@ -1,0 +1,28 @@
+"""Mean squared log error (reference ``functional/regression/log_mse.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = jnp.log1p(preds) - jnp.log1p(target)
+    return jnp.sum(diff * diff), jnp.asarray(target.size)
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Array) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE: mean((log(1+p) - log(1+t))^2)."""
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(jnp.asarray(preds), jnp.asarray(target))
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,27 @@
+"""Mean absolute error (reference ``functional/regression/mae.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), jnp.asarray(target.size)
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Array) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """MAE over all elements."""
+    sum_abs_error, n_obs = _mean_absolute_error_update(jnp.asarray(preds), jnp.asarray(target))
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

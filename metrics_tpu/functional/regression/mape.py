@@ -1,0 +1,34 @@
+"""Mean absolute percentage error (reference ``functional/regression/mape.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPS = 1.17e-06
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPS
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.maximum(jnp.abs(target), epsilon)
+    return jnp.sum(abs_per_error), jnp.asarray(target.size)
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, n_obs: Array) -> Array:
+    return sum_abs_per_error / n_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE: mean(|p - t| / max(|t|, eps))."""
+    sum_abs_per_error, n_obs = _mean_absolute_percentage_error_update(
+        jnp.asarray(preds), jnp.asarray(target)
+    )
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs)

@@ -1,0 +1,28 @@
+"""Mean squared error (reference ``functional/regression/mse.py:22-75``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Fold one batch into (sum of squared errors, observation count)."""
+    _check_same_shape(preds, target)
+    diff = preds.astype(jnp.float32) - target.astype(jnp.float32)
+    return jnp.sum(diff * diff), jnp.asarray(target.size)
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Array, squared: bool = True) -> Array:
+    out = sum_squared_error / n_obs
+    return out if squared else jnp.sqrt(out)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """MSE (or RMSE when ``squared=False``)."""
+    sum_squared_error, n_obs = _mean_squared_error_update(jnp.asarray(preds), jnp.asarray(target))
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

@@ -1,0 +1,50 @@
+"""Spearman rank correlation (reference ``functional/regression/spearman.py``).
+
+TPU-first redesign: the reference averages tied ranks with a Python loop over
+repeated values (``_find_repeats``); here ranking is a branch-free
+``sort + searchsorted`` so it jit-compiles — average rank of value v is
+``(#elements < v) + (#elements == v + 1)/2``.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rank_data(data: Array) -> Array:
+    """Fractional ranks (ties get their average rank), 1-based."""
+    data = jnp.ravel(data)
+    sorted_data = jnp.sort(data)
+    lower = jnp.searchsorted(sorted_data, data, side="left")
+    upper = jnp.searchsorted(sorted_data, data, side="right")
+    return lower.astype(jnp.float32) + (upper - lower + 1).astype(jnp.float32) / 2.0
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
+        raise TypeError(f"Expected preds and target to be floating, got {preds.dtype} and {target.dtype}")
+    _check_same_shape(preds, target)
+    return jnp.ravel(preds), jnp.ravel(target)
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    preds = _rank_data(preds)
+    target = _rank_data(target)
+    preds_diff = preds - jnp.mean(preds)
+    target_diff = target - jnp.mean(target)
+    cov = jnp.mean(preds_diff * target_diff)
+    preds_std = jnp.sqrt(jnp.mean(preds_diff * preds_diff))
+    target_std = jnp.sqrt(jnp.mean(target_diff * target_diff))
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman correlation: pearson on fractional ranks."""
+    preds, target = _spearman_corrcoef_update(jnp.asarray(preds), jnp.asarray(target))
+    return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,66 @@
+"""Tweedie deviance score (reference ``functional/regression/tweedie_deviance.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _validate_tweedie_inputs(preds: Array, targets: Array, power: float) -> None:
+    """Value-dependent domain checks — eager-only (skipped under tracing)."""
+    if isinstance(preds, jax.core.Tracer) or isinstance(targets, jax.core.Tracer):
+        return
+    preds_np = np.asarray(preds)
+    targets_np = np.asarray(targets)
+    if power == 1 or 1 < power < 2:
+        if np.any(preds_np <= 0) or np.any(targets_np < 0):
+            raise ValueError(
+                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+            )
+    elif power < 0:
+        if np.any(preds_np <= 0):
+            raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+    elif power >= 2:
+        if np.any(preds_np <= 0) or np.any(targets_np <= 0):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    _check_same_shape(preds, targets)
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    _validate_tweedie_inputs(preds, targets, power)
+    preds = preds.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+
+    if power == 0:
+        deviance_score = jnp.square(targets - preds)
+    elif power == 1:  # Poisson
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:  # Gamma
+        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
+    else:
+        term_1 = jnp.power(jnp.maximum(targets, 0.0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Mean Tweedie deviance for the given power (0=Normal, 1=Poisson, 2=Gamma)."""
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(
+        jnp.asarray(preds), jnp.asarray(targets), power
+    )
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

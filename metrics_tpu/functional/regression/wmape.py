@@ -1,0 +1,33 @@
+"""Weighted MAPE (reference ``functional/regression/wmape.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPS = 1.17e-06
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPS
+) -> Array:
+    return sum_abs_error / jnp.maximum(sum_scale, epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE: sum(|p - t|) / sum(|t|)."""
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(
+        jnp.asarray(preds), jnp.asarray(target)
+    )
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

@@ -1,0 +1,77 @@
+"""PearsonCorrCoef module metric (reference ``regression/pearson.py:23-66,66-130``).
+
+The six running statistics cannot be merged independently (the variance merge
+needs both means), so sync uses plain all-gather (``dist_reduce_fx=None``) and
+``compute`` folds the per-device rows with the parallel-variance combination
+rule — a jit-friendly ``lax``-free loop over the static device count.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.pearson import (
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array]:
+    """Merge per-device (mean, var, cov) rows via Chan's parallel algorithm."""
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+        delta_x1, delta_x2 = mx1 - mean_x, mx2 - mean_x
+        delta_y1, delta_y2 = my1 - mean_y, my2 - mean_y
+        var_x = vx1 + vx2 + n1 * delta_x1 * delta_x1 + n2 * delta_x2 * delta_x2
+        var_y = vy1 + vy2 + n1 * delta_y1 * delta_y1 + n2 * delta_y2 * delta_y2
+        corr_xy = cxy1 + cxy2 + n1 * delta_x1 * delta_y1 + n2 * delta_x2 * delta_y2
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return vx1, vy1, cxy1, n1
+
+
+class PearsonCorrCoef(Metric):
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        zero = jnp.zeros((1,), dtype=jnp.float32)
+        self.add_state("mean_x", default=zero, dist_reduce_fx=None)
+        self.add_state("mean_y", default=zero, dist_reduce_fx=None)
+        self.add_state("var_x", default=zero, dist_reduce_fx=None)
+        self.add_state("var_y", default=zero, dist_reduce_fx=None)
+        self.add_state("corr_xy", default=zero, dist_reduce_fx=None)
+        self.add_state("n_total", default=zero, dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+        self.mean_x, self.mean_y = mean_x, mean_y
+        self.var_x, self.var_y = var_x, var_y
+        self.corr_xy, self.n_total = corr_xy, n_total
+
+    def compute(self) -> Array:
+        if self.mean_x.shape[0] > 1:  # post-sync: one row per device
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

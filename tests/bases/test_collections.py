@@ -156,3 +156,20 @@ def test_explicit_compute_groups_unlisted_metric_still_updates():
     mc.update(2.0)
     res = mc.compute()
     assert float(res["c"]) == -2.0
+
+
+def test_compute_groups_no_list_alias_after_add_metrics():
+    """add_metrics re-opens group detection; the next full-update pass must not
+    double-append through aliased list states (grouped curve metrics)."""
+    from metrics_tpu.classification import ROC, PrecisionRecallCurve
+
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.random((8, 3), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 3, size=(8,)))
+
+    mc = MetricCollection({"roc": ROC(num_classes=3), "prc": PrecisionRecallCurve(num_classes=3)})
+    mc.update(preds, target)
+    mc.add_metrics({"acc": Accuracy(num_classes=3)})
+    mc.update(preds, target)
+    assert len(mc["roc"]._state["preds"]) == 2
+    assert len(mc["prc"]._state["preds"]) == 2

@@ -45,7 +45,7 @@ class TestConfusionMatrix(MetricTester):
             reference_fn=sk_cm,
             metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
             ddp=ddp,
-            check_batch=(normalize is None) or True,
+            check_batch=True,
         )
 
     def test_confmat_binary(self):

@@ -75,6 +75,12 @@ class TestPrecisionRecall(MetricTester):
             reference_fn=_sk_wrapper(sk_fn, "binary"),
             metric_args={},
         )
+        self.run_functional_metric_test(
+            BIN.preds,
+            BIN.target,
+            metric_functional=functional,
+            reference_fn=_sk_wrapper(sk_fn, "binary"),
+        )
 
     @pytest.mark.parametrize("average", ["micro", "macro"])
     @pytest.mark.parametrize(

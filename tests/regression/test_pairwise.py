@@ -1,0 +1,54 @@
+"""Pairwise functionals vs sklearn.metrics.pairwise oracles."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import pairwise as sk_pairwise
+
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.default_rng(7)
+_x = _rng.random((10, 6)).astype(np.float32)
+_y = _rng.random((8, 6)).astype(np.float32)
+
+_CASES = [
+    (pairwise_cosine_similarity, sk_pairwise.cosine_similarity),
+    (pairwise_euclidean_distance, sk_pairwise.euclidean_distances),
+    (pairwise_linear_similarity, sk_pairwise.linear_kernel),
+    (pairwise_manhattan_distance, sk_pairwise.manhattan_distances),
+]
+
+
+@pytest.mark.parametrize("tm_fn, sk_fn", _CASES)
+def test_pairwise_two_inputs(tm_fn, sk_fn):
+    np.testing.assert_allclose(np.asarray(tm_fn(_x, _y)), sk_fn(_x, _y), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("tm_fn, sk_fn", _CASES)
+def test_pairwise_single_input_zero_diagonal(tm_fn, sk_fn):
+    expected = sk_fn(_x, _x)
+    np.fill_diagonal(expected, 0.0)
+    np.testing.assert_allclose(np.asarray(tm_fn(_x)), expected, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("tm_fn, sk_fn", _CASES)
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_pairwise_reductions(tm_fn, sk_fn, reduction):
+    expected = sk_fn(_x, _y)
+    expected = expected.mean(-1) if reduction == "mean" else expected.sum(-1)
+    np.testing.assert_allclose(
+        np.asarray(tm_fn(_x, _y, reduction=reduction)), expected, atol=1e-5, rtol=1e-4
+    )
+
+
+def test_pairwise_bad_input():
+    with pytest.raises(ValueError):
+        pairwise_cosine_similarity(_x[0])
+    with pytest.raises(ValueError):
+        pairwise_cosine_similarity(_x, _y[:, :3])
+    with pytest.raises(ValueError):
+        pairwise_cosine_similarity(_x, _y, reduction="bogus")

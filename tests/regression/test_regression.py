@@ -1,0 +1,188 @@
+"""Regression metrics vs sklearn/scipy oracles
+(reference test model: ``tests/unittests/regression/``)."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+from scipy import stats
+from sklearn import metrics as sk_metrics
+
+import metrics_tpu.functional.regression as F
+from metrics_tpu.regression import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+_rng = np.random.default_rng(42)
+_preds = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32) + 1.0
+_target = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32) + 1.0
+_preds_2d = _rng.random((NUM_BATCHES, BATCH_SIZE, 4)).astype(np.float32) + 1.0
+_target_2d = _rng.random((NUM_BATCHES, BATCH_SIZE, 4)).astype(np.float32) + 1.0
+
+
+def _sk(fn, **kw):
+    """sklearn takes (y_true, y_pred); the tester calls (preds, target)."""
+    return lambda preds, target: fn(target, preds, **kw)
+
+
+def _smape_ref(preds, target):
+    return np.mean(2 * np.abs(preds - target) / (np.abs(preds) + np.abs(target)))
+
+
+def _wmape_ref(preds, target):
+    return np.sum(np.abs(preds - target)) / np.sum(np.abs(target))
+
+
+def _cosine_ref_sum(preds, target):
+    sim = np.sum(preds * target, -1) / (
+        np.linalg.norm(preds, axis=-1) * np.linalg.norm(target, axis=-1)
+    )
+    return np.sum(sim)
+
+
+class TestBasicRegression(MetricTester):
+    @pytest.mark.parametrize(
+        "metric_class, functional, reference",
+        [
+            (MeanSquaredError, F.mean_squared_error, _sk(sk_metrics.mean_squared_error)),
+            (MeanAbsoluteError, F.mean_absolute_error, _sk(sk_metrics.mean_absolute_error)),
+            (MeanSquaredLogError, F.mean_squared_log_error, _sk(sk_metrics.mean_squared_log_error)),
+            (
+                MeanAbsolutePercentageError,
+                F.mean_absolute_percentage_error,
+                _sk(sk_metrics.mean_absolute_percentage_error),
+            ),
+            (SymmetricMeanAbsolutePercentageError, F.symmetric_mean_absolute_percentage_error, _smape_ref),
+            (WeightedMeanAbsolutePercentageError, F.weighted_mean_absolute_percentage_error, _wmape_ref),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_elementwise(self, metric_class, functional, reference, ddp):
+        self.run_class_metric_test(_preds, _target, metric_class, reference, ddp=ddp)
+        if not ddp:
+            self.run_functional_metric_test(_preds, _target, functional, reference)
+
+    def test_rmse(self):
+        ref = _sk(sk_metrics.mean_squared_error)
+
+        def rmse_ref(preds, target):
+            return np.sqrt(ref(preds, target))
+
+        self.run_class_metric_test(
+            _preds, _target, MeanSquaredError, rmse_ref, metric_args={"squared": False}
+        )
+
+
+class TestCorrelation(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_pearson(self, ddp):
+        def ref(preds, target):
+            return stats.pearsonr(target.ravel(), preds.ravel())[0]
+
+        self.run_class_metric_test(_preds, _target, PearsonCorrCoef, ref, ddp=ddp)
+        self.run_functional_metric_test(_preds, _target, F.pearson_corrcoef, ref)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_spearman(self, ddp):
+        def ref(preds, target):
+            return stats.spearmanr(target.ravel(), preds.ravel())[0]
+
+        self.run_class_metric_test(_preds, _target, SpearmanCorrCoef, ref, ddp=ddp)
+        self.run_functional_metric_test(_preds, _target, F.spearman_corrcoef, ref)
+
+    def test_spearman_with_ties(self):
+        preds = np.asarray([1.0, 2.0, 2.0, 3.0, 1.0, 4.0], dtype=np.float32)
+        target = np.asarray([2.0, 2.0, 1.0, 3.0, 4.0, 4.0], dtype=np.float32)
+        expected = stats.spearmanr(target, preds)[0]
+        np.testing.assert_allclose(
+            np.asarray(F.spearman_corrcoef(preds, target)), expected, atol=1e-5
+        )
+
+
+class TestExplainedVarianceR2(MetricTester):
+    @pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_explained_variance(self, multioutput, ddp):
+        ref = _sk(sk_metrics.explained_variance_score, multioutput=multioutput)
+        self.run_class_metric_test(
+            _preds_2d,
+            _target_2d,
+            ExplainedVariance,
+            ref,
+            metric_args={"multioutput": multioutput},
+            ddp=ddp,
+        )
+        if not ddp:
+            self.run_functional_metric_test(
+                _preds_2d, _target_2d, partial(F.explained_variance, multioutput=multioutput), ref
+            )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_r2(self, ddp):
+        ref = _sk(sk_metrics.r2_score)
+        self.run_class_metric_test(_preds, _target, R2Score, ref, ddp=ddp)
+        self.run_functional_metric_test(_preds, _target, F.r2_score, ref)
+
+    def test_r2_multioutput(self):
+        ref = _sk(sk_metrics.r2_score, multioutput="raw_values")
+        self.run_class_metric_test(
+            _preds_2d,
+            _target_2d,
+            R2Score,
+            ref,
+            metric_args={"num_outputs": 4, "multioutput": "raw_values"},
+        )
+
+    def test_r2_adjusted(self):
+        adjusted = 3
+
+        def ref(preds, target):
+            n = target.shape[0]
+            r2 = sk_metrics.r2_score(target, preds)
+            return 1 - (1 - r2) * (n - 1) / (n - adjusted - 1)
+
+        self.run_class_metric_test(
+            _preds, _target, R2Score, ref, metric_args={"adjusted": adjusted}, check_batch=True
+        )
+
+
+class TestDevianceAndCosine(MetricTester):
+    @pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0, 3.0])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_tweedie(self, power, ddp):
+        ref = _sk(sk_metrics.mean_tweedie_deviance, power=power)
+        self.run_class_metric_test(
+            _preds, _target, TweedieDevianceScore, ref, metric_args={"power": power}, ddp=ddp
+        )
+        if not ddp:
+            self.run_functional_metric_test(
+                _preds, _target, partial(F.tweedie_deviance_score, power=power), ref
+            )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_cosine_similarity(self, ddp):
+        self.run_class_metric_test(
+            _preds_2d, _target_2d, CosineSimilarity, _cosine_ref_sum, ddp=ddp
+        )
+        self.run_functional_metric_test(_preds_2d, _target_2d, F.cosine_similarity, _cosine_ref_sum)
+
+    def test_tweedie_domain_error(self):
+        with pytest.raises(ValueError):
+            TweedieDevianceScore(power=0.5)
+        m = TweedieDevianceScore(power=2.0)
+        with pytest.raises(ValueError):
+            m.update(np.asarray([-1.0, 1.0]), np.asarray([1.0, 1.0]))
