@@ -26,8 +26,12 @@ def _pearson_corrcoef_update(
 ) -> Tuple[Array, Array, Array, Array, Array, Array]:
     """Fold a 1D batch into the running pearson statistics."""
     _check_same_shape(preds, target)
-    preds = jnp.ravel(preds).astype(jnp.float32)
-    target = jnp.ravel(target).astype(jnp.float32)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+    preds = jnp.atleast_1d(preds).astype(jnp.float32)
+    target = jnp.atleast_1d(target).astype(jnp.float32)
 
     n_obs = preds.size
     mx_new = (n_prior * mean_x + jnp.mean(preds) * n_obs) / (n_prior + n_obs)

@@ -29,7 +29,11 @@ def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array
     if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
         raise TypeError(f"Expected preds and target to be floating, got {preds.dtype} and {target.dtype}")
     _check_same_shape(preds, target)
-    return jnp.ravel(preds), jnp.ravel(target)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+    return jnp.atleast_1d(preds), jnp.atleast_1d(target)
 
 
 def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:

@@ -1,0 +1,125 @@
+"""BootStrapper wrapper (reference ``wrappers/bootstrapping.py:26-155``).
+
+Keeps ``num_bootstraps`` clones of the base metric; every update feeds each
+clone a with-replacement resample of the batch along dim 0.  ``'multinomial'``
+keeps the batch shape static (one XLA program for all replicas — the
+TPU-friendly choice); ``'poisson'`` matches the reference's default exactly
+but produces a variable-length resample, so each new length retraces the
+clone's update kernel.
+"""
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(
+    rng: np.random.Generator, size: int, sampling_strategy: str = "poisson"
+) -> np.ndarray:
+    """With-replacement resample indices along dim 0 (reference ``bootstrapping.py:26-46``)."""
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1.0, size=size)
+        return np.repeat(np.arange(size), n)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size=size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    full_state_update = True
+    # update mutates child-metric state outside the swapped pytree → never trace
+    jit_update_default = False
+    jit_compute_default = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Sequence[float]]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: int = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+
+    @staticmethod
+    def _batch_size(args: tuple, kwargs: dict) -> int:
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            if hasattr(leaf, "shape") and getattr(leaf, "ndim", 0) >= 1:
+                return leaf.shape[0]
+        raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Feed each clone a resampled batch (reference ``bootstrapping.py:122-138``)."""
+        size = self._batch_size(args, kwargs)
+        for idx in range(self.num_bootstraps):
+            raw_idx = _bootstrap_sampler(self._rng, size, self.sampling_strategy)
+            if raw_idx.size == 0:  # empty poisson resample would NaN-poison the clone
+                continue
+            sample_idx = jnp.asarray(raw_idx)
+
+            def select(x):
+                if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1:
+                    return jnp.take(jnp.asarray(x), sample_idx, axis=0)
+                return x
+
+            new_args = jax.tree_util.tree_map(select, args)
+            new_kwargs = jax.tree_util.tree_map(select, kwargs)
+            self.metrics[idx]._update_wrapper(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean/std/quantile/raw over the bootstrap replicas (reference ``bootstrapping.py:139-155``)."""
+        # clones that only ever drew empty poisson resamples have no data;
+        # including them would NaN-poison every statistic
+        active = [m for m in self.metrics if m._update_count > 0] or self.metrics
+        computed_vals = jnp.stack([jnp.asarray(m._compute_wrapper()) for m in active], axis=0)
+        output: Dict[str, Array] = {}
+        if self.mean:
+            output["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output["raw"] = computed_vals
+        return output
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Accumulate and return the running bootstrap statistics."""
+        self._update_wrapper(*args, **kwargs)
+        return self._compute_wrapper()
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        self._rng = np.random.default_rng(self.seed)
+        super().reset()

@@ -1,0 +1,44 @@
+"""ClasswiseWrapper (reference ``wrappers/classwise.py:8-80``)."""
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(Metric):
+    """Split a per-class metric output into a ``{name_label: value}`` dict."""
+
+    jit_update_default = False
+    jit_compute_default = False
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of metrics_tpu.Metric but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric._update_wrapper(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric._compute_wrapper())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        return self._convert(self.metric.forward(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()
+        super().reset()

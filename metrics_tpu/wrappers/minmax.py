@@ -1,0 +1,63 @@
+"""MinMaxMetric (reference ``wrappers/minmax.py:23-130``)."""
+
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MinMaxMetric(Metric):
+    """Track the min and max of a wrapped metric's compute across an experiment.
+
+    The min/max are refreshed on every ``compute`` call (reference semantics).
+    """
+
+    full_state_update = True
+    jit_update_default = False
+    jit_compute_default = False
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric._update_wrapper(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric._compute_wrapper()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {val}"
+            )
+        val = jnp.asarray(val)
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        self._update_wrapper(*args, **kwargs)
+        return self._compute_wrapper()
+
+    def reset(self) -> None:
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+        self._base_metric.reset()
+        super().reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Union[int, float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if hasattr(val, "size"):
+            return val.size == 1
+        return False

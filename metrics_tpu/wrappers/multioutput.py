@@ -1,0 +1,92 @@
+"""MultioutputWrapper (reference ``wrappers/multioutput.py:24-160``)."""
+
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where ANY input carries a NaN (reference ``multioutput.py:14-22``)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    nan_idxs = jnp.zeros(tensors[0].shape[0], dtype=bool)
+    for tensor in tensors:
+        flat = tensor.reshape(tensor.shape[0], -1).astype(jnp.float32)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(flat), axis=-1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """One clone of the base metric per output column; no cross-output aggregation."""
+
+    is_differentiable = False
+    full_state_update = True
+    jit_update_default = False
+    jit_compute_default = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[list, dict]]:
+        """Slice inputs to output ``i`` along ``output_dim``; optionally strip NaN rows."""
+        out = []
+        for i in range(len(self.metrics)):
+            def select(x):
+                return jnp.take(jnp.asarray(x), jnp.asarray([i]), axis=self.output_dim)
+
+            selected_args = [select(a) for a in args]
+            selected_kwargs = {k: select(v) for k, v in kwargs.items()}
+            if self.remove_nans:
+                all_vals = selected_args + list(selected_kwargs.values())
+                nan_idxs = np.asarray(_get_nan_indices(*all_vals))
+                keep = ~nan_idxs
+                selected_args = [a[keep] for a in selected_args]
+                selected_kwargs = {k: v[keep] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(a, axis=self.output_dim) for a in selected_args]
+                selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            out.append((selected_args, selected_kwargs))
+        return out
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for metric, (sel_args, sel_kwargs) in zip(self.metrics, self._get_args_kwargs_by_output(*args, **kwargs)):
+            metric._update_wrapper(*sel_args, **sel_kwargs)
+
+    def compute(self) -> List[Array]:
+        return [m._compute_wrapper() for m in self.metrics]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Per-output child forwards (reference ``multioutput.py:131-141``)."""
+        results = [
+            metric.forward(*sel_args, **sel_kwargs)
+            for metric, (sel_args, sel_kwargs) in zip(
+                self.metrics, self._get_args_kwargs_by_output(*args, **kwargs)
+            )
+        ]
+        if any(r is None for r in results):
+            return None
+        return results
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

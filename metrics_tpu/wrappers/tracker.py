@@ -1,0 +1,121 @@
+"""MetricTracker (reference ``wrappers/tracker.py:26-220``)."""
+
+from copy import deepcopy
+from typing import Any, Dict, List, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricTracker:
+    """Track a metric (or collection) over steps/epochs.
+
+    ``increment()`` snapshots a fresh copy; ``update``/``compute``/``forward``
+    address the newest copy; ``compute_all``/``best_metric`` span all steps.
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a metrics_tpu `Metric` or `MetricCollection` "
+                f"but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._steps: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._steps)
+
+    def __len__(self) -> int:
+        return len(self._steps)
+
+    def __getitem__(self, idx: int) -> Union[Metric, MetricCollection]:
+        return self._steps[idx]
+
+    def increment(self) -> None:
+        self._increment_called = True
+        self._steps.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Stack each step's compute along a new leading step axis."""
+        self._check_for_increment("compute_all")
+        res = [m.compute() for m in self._steps]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        if self._steps:
+            self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        for m in self._steps:
+            m.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[float, Tuple[float, int], Dict[str, float], Tuple[Dict[str, float], Dict[str, int]], None]:
+        """Best value (and optionally its step) under the ``maximize`` policy."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    arr = np.asarray(v)
+                    fn = np.argmax if maximize[i] else np.argmin
+                    best = int(fn(arr))
+                    value[k], idx[k] = float(arr[best]), best
+                except (ValueError, TypeError) as err:  # non-scalar outputs
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for {k}: {err}"
+                        " this is probably due to the 'best' not being defined for this metric."
+                        " Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            return (value, idx) if return_step else value
+        try:
+            arr = np.asarray(res)
+            fn = np.argmax if self.maximize else np.argmin
+            best = int(fn(arr))
+            return (float(arr[best]), best) if return_step else float(arr[best])
+        except (ValueError, TypeError) as err:
+            rank_zero_warn(
+                f"Encountered the following error when trying to get the best metric: {err}"
+                " this is probably due to the 'best' not being defined for this metric."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            return (None, None) if return_step else None
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

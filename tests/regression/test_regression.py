@@ -186,3 +186,11 @@ class TestDevianceAndCosine(MetricTester):
         m = TweedieDevianceScore(power=2.0)
         with pytest.raises(ValueError):
             m.update(np.asarray([-1.0, 1.0]), np.asarray([1.0, 1.0]))
+
+
+def test_correlation_rejects_multioutput():
+    p2 = np.ones((4, 2), dtype=np.float32)
+    with pytest.raises(ValueError, match="1 dimensional"):
+        F.pearson_corrcoef(p2, p2)
+    with pytest.raises(ValueError, match="1 dimensional"):
+        F.spearman_corrcoef(p2, p2)

@@ -1,0 +1,188 @@
+"""Wrapper metrics (reference tests: ``tests/unittests/wrappers/``)."""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, r2_score as sk_r2
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanSquaredError,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    R2Score,
+    Recall,
+)
+
+_rng = np.random.default_rng(11)
+
+
+class TestBootStrapper:
+    @pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+    def test_bootstrap_stats(self, sampling_strategy):
+        metric = BootStrapper(
+            Accuracy(num_classes=5, validate_args=False),
+            num_bootstraps=20,
+            quantile=0.95,
+            raw=True,
+            sampling_strategy=sampling_strategy,
+        )
+        for _ in range(4):
+            preds = jnp.asarray(_rng.random((32, 5), dtype=np.float32))
+            target = jnp.asarray(_rng.integers(0, 5, size=(32,)))
+            metric.update(preds, target)
+        out = metric.compute()
+        assert set(out) == {"mean", "std", "quantile", "raw"}
+        assert out["raw"].shape == (20,)
+        # bootstrap mean should be near the point estimate, std small but nonzero
+        assert 0.0 <= float(out["mean"]) <= 1.0
+        assert float(out["std"]) > 0.0
+        assert abs(float(out["mean"]) - float(jnp.mean(out["raw"]))) < 1e-6
+
+    def test_bootstrap_invalid(self):
+        with pytest.raises(ValueError):
+            BootStrapper(Accuracy(num_classes=3), sampling_strategy="bogus")
+        with pytest.raises(ValueError):
+            BootStrapper(object())  # type: ignore[arg-type]
+
+    def test_bootstrap_pickle_and_reset(self):
+        metric = BootStrapper(MeanSquaredError(), num_bootstraps=5)
+        metric.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.5, 2.5, 2.0]))
+        metric = pickle.loads(pickle.dumps(metric))
+        out = metric.compute()
+        assert float(out["mean"]) >= 0
+        metric.reset()
+        assert all(float(m.total) == 0 for m in metric.metrics)
+
+
+class TestClasswiseWrapper:
+    def test_classwise_labels(self):
+        preds = jnp.asarray(_rng.random((40, 3), dtype=np.float32))
+        target = jnp.asarray(_rng.integers(0, 3, size=(40,)))
+        metric = ClasswiseWrapper(Recall(num_classes=3, average="none"), labels=["horse", "fish", "dog"])
+        metric.update(preds, target)
+        out = metric.compute()
+        assert set(out) == {"recall_horse", "recall_fish", "recall_dog"}
+        raw = Recall(num_classes=3, average="none")
+        raw.update(preds, target)
+        expected = raw.compute()
+        for i, key in enumerate(["recall_horse", "recall_fish", "recall_dog"]):
+            np.testing.assert_allclose(np.asarray(out[key]), np.asarray(expected[i]), atol=1e-6)
+
+    def test_classwise_in_collection(self):
+        preds = jnp.asarray(_rng.random((40, 3), dtype=np.float32))
+        target = jnp.asarray(_rng.integers(0, 3, size=(40,)))
+        mc = MetricCollection(
+            {"acc": ClasswiseWrapper(Accuracy(num_classes=3, average="none"), ["a", "b", "c"])}
+        )
+        mc.update(preds, target)
+        out = mc.compute()
+        # dict outputs are flattened with the wrapper's own keys (reference
+        # ClasswiseWrapper example: keys are `accuracy_<label>`)
+        assert set(out) == {"accuracy_a", "accuracy_b", "accuracy_c"}
+
+    def test_classwise_invalid(self):
+        with pytest.raises(ValueError):
+            ClasswiseWrapper(object())  # type: ignore[arg-type]
+        with pytest.raises(ValueError):
+            ClasswiseWrapper(Accuracy(num_classes=3), labels="abc")  # type: ignore[arg-type]
+
+
+class TestMinMaxMetric:
+    def test_minmax_tracks(self):
+        base = Accuracy(num_classes=2, validate_args=False)
+        metric = MinMaxMetric(base)
+        preds_good = jnp.asarray([[0.1, 0.9], [0.2, 0.8]])
+        preds_bad = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        labels = jnp.asarray([1, 1])
+        out1 = metric(preds_good, labels)
+        assert float(out1["raw"]) == 1.0 and float(out1["min"]) == 1.0 and float(out1["max"]) == 1.0
+        metric.update(preds_bad, labels)
+        out2 = metric.compute()
+        assert float(out2["raw"]) == 0.75
+        assert float(out2["min"]) == 0.75
+        assert float(out2["max"]) == 1.0
+        metric.reset()
+        assert float(metric.min_val) == float("inf")
+
+    def test_minmax_scalar_guard(self):
+        metric = MinMaxMetric(Accuracy(num_classes=3, average="none", validate_args=False))
+        metric.update(jnp.asarray(_rng.random((10, 3), dtype=np.float32)), jnp.asarray(_rng.integers(0, 3, 10)))
+        with pytest.raises(RuntimeError):
+            metric.compute()
+
+
+class TestMultioutputWrapper:
+    def test_multioutput_r2(self):
+        preds = _rng.random((30, 2)).astype(np.float32)
+        target = _rng.random((30, 2)).astype(np.float32)
+        metric = MultioutputWrapper(R2Score(), num_outputs=2)
+        metric.update(jnp.asarray(preds), jnp.asarray(target))
+        out = metric.compute()
+        expected = sk_r2(target, preds, multioutput="raw_values")
+        np.testing.assert_allclose([float(o) for o in out], expected, atol=1e-4)
+
+    def test_multioutput_remove_nans(self):
+        preds = np.asarray([[1.0, 2.0], [2.0, np.nan], [3.0, 4.0]], dtype=np.float32)
+        target = np.asarray([[1.0, 2.0], [2.0, 3.0], [3.0, 4.0]], dtype=np.float32)
+        metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        metric.update(jnp.asarray(preds), jnp.asarray(target))
+        out = metric.compute()
+        np.testing.assert_allclose(float(out[0]), 0.0, atol=1e-6)
+        np.testing.assert_allclose(float(out[1]), 0.0, atol=1e-6)  # NaN row dropped
+
+
+class TestMetricTracker:
+    def test_tracker_single_metric(self):
+        tracker = MetricTracker(Accuracy(num_classes=5, validate_args=False), maximize=True)
+        accs = []
+        for epoch in range(4):
+            tracker.increment()
+            for _ in range(3):
+                preds = jnp.asarray(_rng.random((16, 5), dtype=np.float32))
+                target = jnp.asarray(_rng.integers(0, 5, size=(16,)))
+                tracker.update(preds, target)
+            accs.append(float(tracker.compute()))
+        all_res = np.asarray(tracker.compute_all())
+        np.testing.assert_allclose(all_res, accs, atol=1e-6)
+        best, step = tracker.best_metric(return_step=True)
+        assert best == pytest.approx(max(accs), abs=1e-6)
+        assert step == int(np.argmax(accs))
+        assert tracker.n_steps == 4
+
+    def test_tracker_collection(self):
+        tracker = MetricTracker(
+            MetricCollection([MeanSquaredError(), R2Score()]), maximize=[False, True]
+        )
+        for epoch in range(3):
+            tracker.increment()
+            preds = jnp.asarray(_rng.random(50, dtype=np.float32))
+            target = jnp.asarray(_rng.random(50, dtype=np.float32))
+            tracker.update(preds, target)
+        res = tracker.compute_all()
+        assert set(res) == {"MeanSquaredError", "R2Score"}
+        assert res["MeanSquaredError"].shape == (3,)
+        best, steps = tracker.best_metric(return_step=True)
+        mse_vals = np.asarray(res["MeanSquaredError"])
+        assert best["MeanSquaredError"] == pytest.approx(float(mse_vals.min()), abs=1e-6)
+        assert steps["MeanSquaredError"] == int(mse_vals.argmin())
+
+    def test_tracker_guards(self):
+        tracker = MetricTracker(MeanSquaredError())
+        with pytest.raises(ValueError, match="cannot be called before"):
+            tracker.update(jnp.asarray([1.0]), jnp.asarray([1.0]))
+        with pytest.raises(TypeError):
+            MetricTracker(object())  # type: ignore[arg-type]
+
+    def test_bootstrap_empty_poisson_resample_skipped(self):
+        metric = BootStrapper(MeanSquaredError(), num_bootstraps=50, sampling_strategy="poisson")
+        metric.update(jnp.asarray([1.0]), jnp.asarray([2.0]))  # ~37% of clones draw empty
+        out = metric.compute()
+        assert np.isfinite(float(out["mean"]))
+        assert np.isfinite(float(out["std"]))
