@@ -1,0 +1,29 @@
+"""retrieval_average_precision (reference ``functional/retrieval/average_precision.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array, validate_args: bool = True) -> Array:
+    """Average precision of a single query's ranked documents.
+
+    Jit-friendly reformulation of reference ``average_precision.py:43-49``:
+    the boolean gather of hit positions becomes a masked mean.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_average_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    t = target[jnp.argsort(-preds)].astype(jnp.float32)
+    ranks = jnp.arange(1, t.shape[0] + 1, dtype=jnp.float32)
+    prec_at_hit = jnp.where(t > 0, jnp.cumsum(t) / ranks, 0.0)
+    n_rel = t.sum()
+    return jnp.where(n_rel > 0, prec_at_hit.sum() / jnp.clip(n_rel, 1.0, None), 0.0)

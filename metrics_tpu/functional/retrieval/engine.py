@@ -1,0 +1,224 @@
+"""Vectorized retrieval engine: all queries in one XLA program.
+
+The reference computes every retrieval metric with a per-query Python loop
+(``retrieval/base.py:124-137`` — slice out each group, sort it, score it).
+That pattern is hostile to TPUs: O(n_queries) kernel launches and ragged
+shapes.  Here the whole epoch is scored at once:
+
+1. one ``lexsort`` orders every document by ``(query, -pred)``;
+2. within-query ranks come from segment offsets (cumsum of group counts);
+3. each metric is a handful of ``segment_sum``/``segment_min`` reductions
+   over the rank-annotated flat arrays.
+
+Everything is O(N log N) with static shapes per call, so ``jax.jit`` compiles
+one fused program per (N, n_groups) signature (one compile per epoch shape).
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def contiguous_groups(indexes: Array) -> Tuple[Array, int]:
+    """Remap arbitrary query ids to contiguous ``0..n_groups-1`` (host-side).
+
+    Mirrors reference ``utilities/data.py:get_group_indexes`` which buckets by
+    raw id; contiguous ids let the engine use dense segment reductions.
+    """
+    idx = np.asarray(indexes)
+    _, inverse = np.unique(idx, return_inverse=True)
+    n_groups = int(inverse.max()) + 1 if inverse.size else 0
+    return jnp.asarray(inverse.reshape(-1)), n_groups
+
+
+def _group_layout(preds: Array, group: Array, n_groups: int):
+    """Sort by (group, -pred); return sort order, sorted group ids, 0-based
+    within-group ranks, per-group counts and block starts."""
+    n = group.shape[0]
+    order = jnp.lexsort((-preds, group))
+    g = group[order]
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), group, n_groups)
+    starts = jnp.cumsum(counts) - counts
+    rank = jnp.arange(n) - starts[g]
+    return order, g, rank, counts, starts
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def group_relevant_counts(target: Array, group: Array, n_groups: int) -> Array:
+    return jax.ops.segment_sum(target.astype(jnp.float32), group, n_groups)
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def average_precision_per_group(preds: Array, target: Array, group: Array, n_groups: int) -> Array:
+    """AP per query (reference ``functional/retrieval/average_precision.py:43-49``)."""
+    order, g, rank, _, starts = _group_layout(preds, group, n_groups)
+    t = target[order].astype(jnp.float32)
+    cs = jnp.cumsum(t)
+    base = jnp.where(starts > 0, cs[jnp.maximum(starts - 1, 0)], 0.0)
+    hits_so_far = cs - base[g]
+    prec_at_hit = jnp.where(t > 0, hits_so_far / (rank + 1.0), 0.0)
+    n_rel = jax.ops.segment_sum(t, g, n_groups)
+    return jax.ops.segment_sum(prec_at_hit, g, n_groups) / jnp.clip(n_rel, 1.0, None)
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def reciprocal_rank_per_group(preds: Array, target: Array, group: Array, n_groups: int) -> Array:
+    """RR per query (reference ``functional/retrieval/reciprocal_rank.py:44-49``)."""
+    order, g, rank, _, _ = _group_layout(preds, group, n_groups)
+    t = target[order]
+    masked_rank = jnp.where(t > 0, (rank + 1).astype(jnp.float32), jnp.inf)
+    first = jax.ops.segment_min(masked_rank, g, n_groups)
+    return jnp.where(jnp.isfinite(first), 1.0 / first, 0.0)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "k", "adaptive_k"))
+def precision_per_group(
+    preds: Array, target: Array, group: Array, n_groups: int,
+    k: Optional[int] = None, adaptive_k: bool = False,
+) -> Array:
+    """Precision@k per query (reference ``functional/retrieval/precision.py:55-65``)."""
+    order, g, rank, counts, _ = _group_layout(preds, group, n_groups)
+    t = target[order].astype(jnp.float32)
+    countsf = counts.astype(jnp.float32)
+    if k is None:
+        in_top = jnp.ones_like(t)
+        denom = countsf
+    else:
+        in_top = (rank < k).astype(jnp.float32)
+        denom = jnp.minimum(float(k), countsf) if adaptive_k else jnp.full((n_groups,), float(k))
+    hits = jax.ops.segment_sum(t * in_top, g, n_groups)
+    return hits / jnp.clip(denom, 1.0, None)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "k"))
+def recall_per_group(
+    preds: Array, target: Array, group: Array, n_groups: int, k: Optional[int] = None
+) -> Array:
+    """Recall@k per query (reference ``functional/retrieval/recall.py:53-61``)."""
+    order, g, rank, _, _ = _group_layout(preds, group, n_groups)
+    t = target[order].astype(jnp.float32)
+    in_top = jnp.ones_like(t) if k is None else (rank < k).astype(jnp.float32)
+    hits = jax.ops.segment_sum(t * in_top, g, n_groups)
+    n_rel = jax.ops.segment_sum(t, g, n_groups)
+    return hits / jnp.clip(n_rel, 1.0, None)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "k"))
+def fall_out_per_group(
+    preds: Array, target: Array, group: Array, n_groups: int, k: Optional[int] = None
+) -> Array:
+    """Fall-out@k per query (reference ``functional/retrieval/fall_out.py:52-62``)."""
+    order, g, rank, counts, _ = _group_layout(preds, group, n_groups)
+    neg = 1.0 - target[order].astype(jnp.float32)
+    in_top = jnp.ones_like(neg) if k is None else (rank < k).astype(jnp.float32)
+    neg_hits = jax.ops.segment_sum(neg * in_top, g, n_groups)
+    n_neg = jax.ops.segment_sum(neg, g, n_groups)
+    return neg_hits / jnp.clip(n_neg, 1.0, None)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "k"))
+def hit_rate_per_group(
+    preds: Array, target: Array, group: Array, n_groups: int, k: Optional[int] = None
+) -> Array:
+    """HitRate@k per query (reference ``functional/retrieval/hit_rate.py:49-57``)."""
+    order, g, rank, _, _ = _group_layout(preds, group, n_groups)
+    t = target[order].astype(jnp.float32)
+    in_top = jnp.ones_like(t) if k is None else (rank < k).astype(jnp.float32)
+    hits = jax.ops.segment_sum(t * in_top, g, n_groups)
+    return (hits > 0).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def r_precision_per_group(preds: Array, target: Array, group: Array, n_groups: int) -> Array:
+    """R-Precision per query (reference ``functional/retrieval/r_precision.py:42-49``)."""
+    order, g, rank, _, _ = _group_layout(preds, group, n_groups)
+    t = target[order].astype(jnp.float32)
+    n_rel = jax.ops.segment_sum(t, g, n_groups)
+    in_top_r = (rank < n_rel[g]).astype(jnp.float32)
+    hits = jax.ops.segment_sum(t * in_top_r, g, n_groups)
+    return hits / jnp.clip(n_rel, 1.0, None)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "k"))
+def ndcg_per_group(
+    preds: Array, target: Array, group: Array, n_groups: int, k: Optional[int] = None
+) -> Array:
+    """nDCG@k per query (reference ``functional/retrieval/ndcg.py:27-72``).
+
+    The ideal ordering reuses the same rank array: ranks depend only on group
+    block layout, which is identical for both lexsorts.
+    """
+    tf = target.astype(jnp.float32)
+    order, g, rank, _, _ = _group_layout(preds, group, n_groups)
+    in_top = jnp.ones(rank.shape) if k is None else (rank < k).astype(jnp.float32)
+    disc = 1.0 / jnp.log2(rank.astype(jnp.float32) + 2.0)
+    dcg = jax.ops.segment_sum(tf[order] * disc * in_top, g, n_groups)
+    ideal_order = jnp.lexsort((-tf, group))
+    idcg = jax.ops.segment_sum(tf[ideal_order] * disc * in_top, g, n_groups)
+    return jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), 0.0)
+
+
+@partial(jax.jit, static_argnames=("n_groups", "max_k", "adaptive_k"))
+def precision_recall_curve_per_group(
+    preds: Array, target: Array, group: Array, n_groups: int,
+    max_k: int, adaptive_k: bool = False,
+) -> Tuple[Array, Array]:
+    """(precision, recall) @ k=1..max_k per query, shapes ``(n_groups, max_k)``
+    (reference ``functional/retrieval/precision_recall_curve.py:71-97``).
+
+    A scatter builds the dense (query, rank) hit table; one cumsum along the
+    rank axis yields every top-k count at once.
+    """
+    order, g, rank, counts, _ = _group_layout(preds, group, n_groups)
+    t = target[order].astype(jnp.float32)
+    table = jnp.zeros((n_groups, max_k))
+    table = table.at[g, jnp.minimum(rank, max_k - 1)].add(jnp.where(rank < max_k, t, 0.0))
+    rel = jnp.cumsum(table, axis=1)
+    topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    countsf = counts.astype(jnp.float32)
+    if adaptive_k:
+        denom = jnp.minimum(topk[None, :], countsf[:, None])
+    else:
+        denom = jnp.broadcast_to(topk[None, :], (n_groups, max_k))
+    n_rel = jax.ops.segment_sum(t, g, n_groups)
+    precision = rel / jnp.clip(denom, 1.0, None)
+    recall = rel / jnp.clip(n_rel, 1.0, None)[:, None]
+    return precision, recall
+
+
+def reduce_over_groups(
+    scores: Array,
+    empty: Array,
+    empty_target_action: str,
+    empty_kind: str = "positive",
+) -> Array:
+    """Apply the per-query empty-target policy then mean over queries
+    (reference ``retrieval/base.py:124-139``).
+
+    ``scores``: ``(n_groups,)`` or ``(n_groups, K)``; ``empty``: ``(n_groups,)`` bool;
+    ``empty_kind`` names the missing target class in the error message
+    (fall-out queries are empty when they lack *negative* targets,
+    reference ``retrieval/fall_out.py:113``).
+    """
+    if empty_target_action == "error":
+        if bool(jnp.any(empty)):
+            raise ValueError(
+                f"`compute` method was provided with a query with no {empty_kind} target."
+            )
+        return scores.mean(axis=0)
+    emask = empty if scores.ndim == 1 else empty[:, None]
+    if empty_target_action == "pos":
+        return jnp.where(emask, 1.0, scores).mean(axis=0)
+    if empty_target_action == "neg":
+        return jnp.where(emask, 0.0, scores).mean(axis=0)
+    # skip
+    valid = (~empty).astype(scores.dtype)
+    n_valid = valid.sum()
+    vmask = valid if scores.ndim == 1 else valid[:, None]
+    out = (scores * vmask).sum(axis=0) / jnp.clip(n_valid, 1.0, None)
+    return jnp.where(n_valid > 0, out, jnp.zeros_like(out))

@@ -1,0 +1,32 @@
+"""retrieval_fall_out (reference ``functional/retrieval/fall_out.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_fall_out(
+    preds: Array, target: Array, k: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    """Fall-out@k: fraction of non-relevant docs retrieved in the top k among
+    all non-relevant docs (reference ``fall_out.py:52-62``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_fall_out(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(1., dtype=float32)
+    """
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    if k is None:
+        k = preds.shape[0]
+    neg = 1 - target[jnp.argsort(-preds)].astype(jnp.float32)
+    hits = neg[: min(k, preds.shape[0])].sum()
+    n_neg = neg.sum()
+    return jnp.where(n_neg > 0, hits / jnp.clip(n_neg, 1.0, None), 0.0)

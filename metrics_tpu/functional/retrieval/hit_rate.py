@@ -1,0 +1,30 @@
+"""retrieval_hit_rate (reference ``functional/retrieval/hit_rate.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(
+    preds: Array, target: Array, k: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    """HitRate@k for a single query (reference ``hit_rate.py:49-57``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_hit_rate(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(1., dtype=float32)
+    """
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    if k is None:
+        k = preds.shape[0]
+    t = target[jnp.argsort(-preds)].astype(jnp.float32)
+    hits = t[: min(k, preds.shape[0])].sum()
+    return (hits > 0).astype(jnp.float32)

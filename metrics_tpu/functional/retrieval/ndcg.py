@@ -1,0 +1,40 @@
+"""retrieval_normalized_dcg (reference ``functional/retrieval/ndcg.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return (target / denom).sum(axis=-1)
+
+
+def retrieval_normalized_dcg(
+    preds: Array, target: Array, k: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    """nDCG@k for a single query; non-binary (graded) targets allowed
+    (reference ``ndcg.py:45-72``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_normalized_dcg(jnp.array([.1, .2, .3, 4., 70.]), jnp.array([10, 0, 0, 1, 5]))
+        Array(0.69569725, dtype=float32)
+    """
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    preds, target = _check_retrieval_functional_inputs(
+        preds, target, allow_non_binary_target=True, validate_args=validate_args
+    )
+    k = preds.shape[-1] if k is None else k
+    tf = target.astype(jnp.float32)
+    sorted_target = tf[jnp.argsort(-preds)][:k]
+    ideal_target = -jnp.sort(-tf)[:k]
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    return jnp.where(ideal_dcg > 0, target_dcg / jnp.where(ideal_dcg > 0, ideal_dcg, 1.0), 0.0)

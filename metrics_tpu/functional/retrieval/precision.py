@@ -1,0 +1,37 @@
+"""retrieval_precision (reference ``functional/retrieval/precision.py``)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_precision(
+    preds: Array,
+    target: Array,
+    k: Optional[int] = None,
+    adaptive_k: bool = False,
+    validate_args: bool = True,
+) -> Array:
+    """Precision@k for a single query (reference ``precision.py:55-65``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(0.5, dtype=float32)
+    """
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    n = preds.shape[0]
+    if k is None or (adaptive_k and k > n):
+        k = n
+    t = target[jnp.argsort(-preds)].astype(jnp.float32)
+    hits = t[: min(k, n)].sum()
+    return jnp.where(target.sum() > 0, hits / k, 0.0)

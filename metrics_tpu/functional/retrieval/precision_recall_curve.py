@@ -1,0 +1,52 @@
+"""retrieval_precision_recall_curve (reference
+``functional/retrieval/precision_recall_curve.py``)."""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    max_k: Optional[int] = None,
+    adaptive_k: bool = False,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Precision/recall pairs at every k in ``1..max_k`` for one query
+    (reference ``precision_recall_curve.py:71-97``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p, r, k = retrieval_precision_recall_curve(
+        ...     jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), max_k=2)
+        >>> p, r, k
+        (Array([1. , 0.5], dtype=float32), Array([0.5, 0.5], dtype=float32), Array([1, 2], dtype=int32))
+    """
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    n = preds.shape[-1]
+    if max_k is None:
+        max_k = n
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+
+    if adaptive_k and max_k > n:
+        topk = jnp.concatenate(
+            [jnp.arange(1, n + 1), jnp.full((max_k - n,), n, dtype=jnp.int32)]
+        )
+    else:
+        topk = jnp.arange(1, max_k + 1)
+
+    t = target[jnp.argsort(-preds)].astype(jnp.float32)[: min(max_k, n)]
+    relevant = jnp.cumsum(jnp.pad(t, (0, max(0, max_k - t.shape[0]))))
+    n_rel = target.sum()
+    recall = jnp.where(n_rel > 0, relevant / jnp.clip(n_rel, 1.0, None), 0.0)
+    precision = jnp.where(n_rel > 0, relevant / topk, 0.0)
+    return precision, recall, topk

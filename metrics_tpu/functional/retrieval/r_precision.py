@@ -1,0 +1,27 @@
+"""retrieval_r_precision (reference ``functional/retrieval/r_precision.py``)."""
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array, validate_args: bool = True) -> Array:
+    """R-Precision: precision in the top R where R = number of relevant docs
+    (reference ``r_precision.py:42-49``).
+
+    Jit-friendly: the data-dependent top-R slice becomes a rank mask.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_r_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    t = target[jnp.argsort(-preds)].astype(jnp.float32)
+    n_rel = t.sum()
+    rank = jnp.arange(t.shape[0], dtype=jnp.float32)
+    hits = jnp.where(rank < n_rel, t, 0.0).sum()
+    return jnp.where(n_rel > 0, hits / jnp.clip(n_rel, 1.0, None), 0.0)

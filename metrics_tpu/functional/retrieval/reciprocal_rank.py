@@ -1,0 +1,24 @@
+"""retrieval_reciprocal_rank (reference ``functional/retrieval/reciprocal_rank.py``)."""
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, validate_args: bool = True) -> Array:
+    """Reciprocal rank of the first relevant document
+    (reference ``reciprocal_rank.py:44-49``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_reciprocal_rank(jnp.array([0.2, 0.3, 0.5]), jnp.array([False, True, False]))
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, validate_args=validate_args)
+    t = target[jnp.argsort(-preds)]
+    ranks = jnp.arange(1, t.shape[0] + 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(t > 0, ranks, jnp.inf))
+    return jnp.where(jnp.isfinite(first), 1.0 / first, 0.0)

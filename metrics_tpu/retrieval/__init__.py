@@ -1,0 +1,29 @@
+"""Retrieval metrics (reference ``src/torchmetrics/retrieval/``)."""
+
+from metrics_tpu.retrieval.average_precision import RetrievalMAP
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.retrieval.fall_out import RetrievalFallOut
+from metrics_tpu.retrieval.hit_rate import RetrievalHitRate
+from metrics_tpu.retrieval.ndcg import RetrievalNormalizedDCG
+from metrics_tpu.retrieval.precision import RetrievalPrecision
+from metrics_tpu.retrieval.precision_recall_curve import (
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecallAtFixedPrecision,
+)
+from metrics_tpu.retrieval.r_precision import RetrievalRPrecision
+from metrics_tpu.retrieval.recall import RetrievalRecall
+from metrics_tpu.retrieval.reciprocal_rank import RetrievalMRR
+
+__all__ = [
+    "RetrievalMetric",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalPrecision",
+    "RetrievalRecall",
+    "RetrievalFallOut",
+    "RetrievalNormalizedDCG",
+    "RetrievalHitRate",
+    "RetrievalRPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRecallAtFixedPrecision",
+]

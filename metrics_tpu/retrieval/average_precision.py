@@ -1,0 +1,23 @@
+"""RetrievalMAP (reference ``retrieval/average_precision.py:20-70``)."""
+
+from typing import Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import average_precision_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean Average Precision over queries."""
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = average_precision_per_group(preds, target, group, n_groups)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+
+        return retrieval_average_precision(preds, target)

@@ -1,0 +1,119 @@
+"""RetrievalMetric base class (reference ``retrieval/base.py:27-147``).
+
+TPU-first delta: the reference's compute slices out each query and scores it
+in a Python loop (``base.py:124-137``).  Here subclasses implement
+``_group_scores`` — one vectorized call into
+:mod:`metrics_tpu.functional.retrieval.engine` that scores *all* queries in a
+single XLA program.  A default ``_group_scores`` is provided for user
+subclasses that only override the reference-style per-query ``_metric``.
+"""
+
+from abc import abstractmethod
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.retrieval.engine import (
+    contiguous_groups,
+    group_relevant_counts,
+    reduce_over_groups,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+_EMPTY_TARGET_ACTIONS = ("error", "skip", "neg", "pos")
+
+
+class RetrievalMetric(Metric):
+    """Mean-over-queries retrieval metric on binary relevance targets.
+
+    ``update`` accepts flat ``preds``/``target``/``indexes`` of the same shape;
+    ``indexes`` assigns every prediction to a query.  ``compute`` groups by
+    query, scores each query, applies ``empty_target_action`` to queries with
+    no positive target and averages (reference ``retrieval/base.py:110-139``).
+
+    Args:
+        empty_target_action: one of ``'neg'`` (score 0), ``'pos'`` (score 1),
+            ``'skip'`` (drop query), ``'error'`` (raise).
+        ignore_index: drop rows whose target equals this value.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    jit_compute_default = False  # host-orchestrated: calls the jitted engine itself
+    _empty_kind = "positive"  # which missing target class makes a query "empty"
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+        if empty_target_action not in _EMPTY_TARGET_ACTIONS:
+            raise ValueError(
+                f"Argument `empty_target_action` received a wrong value `{empty_target_action}`."
+            )
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Validate, flatten and append the batch (reference ``base.py:97-108``)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes,
+            preds,
+            target,
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        group, n_groups = contiguous_groups(indexes)
+        scores, empty = self._group_scores(preds, target, group, n_groups)
+        return reduce_over_groups(scores, empty, self.empty_target_action, self._empty_kind)
+
+    def _empty_mask(self, target: Array, group: Array, n_groups: int) -> Array:
+        """Queries with no positive target (reference ``base.py:128``)."""
+        return group_relevant_counts(target, group, n_groups) == 0
+
+    def _group_scores(
+        self, preds: Array, target: Array, group: Array, n_groups: int
+    ) -> Tuple[Array, Array]:
+        """Score every query at once; returns ``(scores, empty_mask)``.
+
+        Built-in subclasses override this with a vectorized engine call; the
+        default loops queries through the reference-style :meth:`_metric`
+        extension point so user subclasses keep working.
+        """
+        group_np = np.asarray(group)
+        scores = []
+        for gid in range(n_groups):
+            mask = group_np == gid
+            scores.append(self._metric(preds[mask], target[mask]))
+        empty = self._empty_mask(target, group, n_groups)
+        return jnp.stack(scores) if scores else jnp.zeros((0,)), empty
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Per-query score; override when not using ``_group_scores``."""
+        raise NotImplementedError

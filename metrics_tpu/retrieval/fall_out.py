@@ -1,0 +1,48 @@
+"""RetrievalFallOut (reference ``retrieval/fall_out.py:24-125``)."""
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import fall_out_per_group, group_relevant_counts
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Fall-out@k averaged over queries.
+
+    Lower is better; a query is "empty" when it has no *negative* target
+    (reference overrides ``compute`` for this — ``fall_out.py:93-122``).
+    """
+
+    higher_is_better = False
+    _empty_kind = "negative"
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if k is not None and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _empty_mask(self, target, group, n_groups) -> Array:
+        # empty = no negative targets: count of (1 - target) per group == 0
+        n_total = group_relevant_counts(jax.numpy.ones_like(target), group, n_groups)
+        n_rel = group_relevant_counts(target, group, n_groups)
+        return (n_total - n_rel) == 0
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = fall_out_per_group(preds, target, group, n_groups, k=self.k)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+
+        return retrieval_fall_out(preds, target, k=self.k)

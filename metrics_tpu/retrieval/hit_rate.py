@@ -1,0 +1,35 @@
+"""RetrievalHitRate (reference ``retrieval/hit_rate.py:22-92``)."""
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import hit_rate_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """HitRate@k averaged over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if k is not None and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = hit_rate_per_group(preds, target, group, n_groups, k=self.k)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate
+
+        return retrieval_hit_rate(preds, target, k=self.k)

@@ -1,0 +1,36 @@
+"""RetrievalNormalizedDCG (reference ``retrieval/ndcg.py:22-93``)."""
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import ndcg_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """nDCG@k averaged over queries; graded (non-binary) relevance allowed."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if k is not None and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+        self.allow_non_binary_target = True
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = ndcg_per_group(preds, target, group, n_groups, k=self.k)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+
+        return retrieval_normalized_dcg(preds, target, k=self.k)

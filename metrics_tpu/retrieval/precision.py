@@ -1,0 +1,46 @@
+"""RetrievalPrecision (reference ``retrieval/precision.py:22-99``)."""
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import precision_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k averaged over queries.
+
+    Args:
+        k: consider only the top k documents per query (None = all).
+        adaptive_k: per query, use ``min(k, n_documents)`` as denominator.
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if k is not None and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.k = k
+        self.adaptive_k = adaptive_k
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = precision_per_group(
+            preds, target, group, n_groups, k=self.k, adaptive_k=self.adaptive_k
+        )
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.precision import retrieval_precision
+
+        return retrieval_precision(preds, target, k=self.k, adaptive_k=self.adaptive_k)

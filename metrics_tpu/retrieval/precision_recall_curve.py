@@ -1,0 +1,129 @@
+"""RetrievalPrecisionRecallCurve / RetrievalRecallAtFixedPrecision
+(reference ``retrieval/precision_recall_curve.py:55-291``)."""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.retrieval.engine import (
+    contiguous_groups,
+    group_relevant_counts,
+    precision_recall_curve_per_group,
+)
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Max recall subject to precision >= min_precision
+    (reference ``precision_recall_curve.py:25-52``)."""
+    p = np.asarray(precision)
+    r = np.asarray(recall)
+    k = np.asarray(top_k)
+    candidates = [(rv, kv) for pv, rv, kv in zip(p, r, k) if pv >= min_precision]
+    if candidates:
+        max_recall, best_k = max(candidates)
+    else:
+        max_recall, best_k = 0.0, len(k)
+    if max_recall == 0.0:
+        best_k = len(k)
+    return jnp.asarray(max_recall, dtype=jnp.float32), jnp.asarray(best_k, dtype=jnp.int32)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Mean precision/recall at every k in ``1..max_k`` over queries.
+
+    Vectorized delta: all queries are scored in one scatter+cumsum program
+    (``engine.precision_recall_curve_per_group``) instead of the reference's
+    per-query loop (``precision_recall_curve.py:184-201``).
+    """
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs
+        )
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        self.max_k = max_k
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        group, n_groups = contiguous_groups(indexes)
+
+        max_k = self.max_k
+        if max_k is None:
+            counts = np.bincount(np.asarray(group), minlength=n_groups)
+            max_k = int(counts.max()) if counts.size else 1
+
+        precision, recall = precision_recall_curve_per_group(
+            preds, target, group, n_groups, max_k=max_k, adaptive_k=self.adaptive_k
+        )
+        empty = group_relevant_counts(target, group, n_groups) == 0
+        top_k = jnp.arange(1, max_k + 1)
+        if self.empty_target_action == "error" and bool(jnp.any(empty)):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "pos":
+            precision = jnp.where(empty[:, None], 1.0, precision)
+            recall = jnp.where(empty[:, None], 1.0, recall)
+        elif self.empty_target_action == "neg":
+            precision = jnp.where(empty[:, None], 0.0, precision)
+            recall = jnp.where(empty[:, None], 0.0, recall)
+        elif self.empty_target_action == "skip":
+            keep = ~empty
+            n_keep = keep.sum()
+            w = keep.astype(precision.dtype)[:, None]
+            precision = jnp.where(
+                n_keep > 0, (precision * w).sum(0) / jnp.clip(n_keep, 1, None), jnp.zeros((max_k,))
+            )
+            recall = jnp.where(
+                n_keep > 0, (recall * w).sum(0) / jnp.clip(n_keep, 1, None), jnp.zeros((max_k,))
+            )
+            return precision, recall, top_k
+        return precision.mean(axis=0), recall.mean(axis=0), top_k
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall with precision >= ``min_precision``
+    (reference ``precision_recall_curve.py:212-291``)."""
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k,
+            adaptive_k=adaptive_k,
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        precisions, recalls, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precisions, recalls, top_k, self.min_precision)

@@ -1,0 +1,23 @@
+"""RetrievalRPrecision (reference ``retrieval/r_precision.py:20-70``)."""
+
+from typing import Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import r_precision_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-Precision averaged over queries."""
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = r_precision_per_group(preds, target, group, n_groups)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision
+
+        return retrieval_r_precision(preds, target)

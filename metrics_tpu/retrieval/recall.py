@@ -1,0 +1,35 @@
+"""RetrievalRecall (reference ``retrieval/recall.py:22-91``)."""
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import recall_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Recall@k averaged over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if k is not None and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = recall_per_group(preds, target, group, n_groups, k=self.k)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.recall import retrieval_recall
+
+        return retrieval_recall(preds, target, k=self.k)

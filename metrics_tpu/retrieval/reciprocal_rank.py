@@ -1,0 +1,23 @@
+"""RetrievalMRR (reference ``retrieval/reciprocal_rank.py:20-69``)."""
+
+from typing import Tuple
+
+import jax
+
+from metrics_tpu.functional.retrieval.engine import reciprocal_rank_per_group
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean Reciprocal Rank over queries."""
+
+    def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
+        scores = reciprocal_rank_per_group(preds, target, group, n_groups)
+        return scores, self._empty_mask(target, group, n_groups)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+
+        return retrieval_reciprocal_rank(preds, target)

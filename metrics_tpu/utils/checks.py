@@ -338,3 +338,87 @@ def check_forward_full_state_property(
                 times.append(time.perf_counter() - start)
             print(f"full_state_update={label}: {np.mean(times):.4g}s +- {np.std(times):.2g} for {n_updates} steps")
     print(f"Recommended setting `full_state_update=False` for {metric_class.__name__} (results match).")
+
+
+# --------------------------------------------------------------------- retrieval
+def _check_retrieval_target_and_prediction_types(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+) -> Tuple[Array, Array]:
+    """Dtype/value checks for retrieval inputs
+    (reference ``utilities/checks.py:583-610``)."""
+    if not (
+        target.dtype == jnp.bool_
+        or jnp.issubdtype(target.dtype, jnp.integer)
+        or jnp.issubdtype(target.dtype, jnp.floating)
+    ):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target and not _is_tracer(target):
+        if bool(jnp.any(target > 1)) or bool(jnp.any(target < 0)):
+            raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
+    return preds.astype(jnp.float32).reshape(-1), target.reshape(-1)
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Shape + dtype checks for a single query's (preds, target)
+    (reference ``utilities/checks.py:504-531``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if not validate_args:
+        return preds.astype(jnp.float32).reshape(-1), (
+            target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
+        ).reshape(-1)
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(
+        preds, target, allow_non_binary_target=allow_non_binary_target
+    )
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Shape + dtype checks for (indexes, preds, target) triplets
+    (reference ``utilities/checks.py:534-580``); drops rows whose target
+    equals ``ignore_index``."""
+    indexes = jnp.asarray(indexes)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if validate_args:
+        if indexes.shape != preds.shape or preds.shape != target.shape:
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        if not jnp.issubdtype(indexes.dtype, jnp.integer):
+            raise ValueError("`indexes` must be a tensor of long integers")
+    if ignore_index is not None:
+        valid = (target != ignore_index).reshape(-1)
+        indexes = indexes.reshape(-1)[valid]
+        preds = preds.reshape(-1)[valid]
+        target = target.reshape(-1)[valid]
+    if validate_args:
+        if indexes.size == 0 or indexes.ndim == 0:
+            raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+        preds, target = _check_retrieval_target_and_prediction_types(
+            preds, target, allow_non_binary_target=allow_non_binary_target
+        )
+    else:
+        preds = preds.astype(jnp.float32).reshape(-1)
+        target = (
+            target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
+        ).reshape(-1)
+    return indexes.astype(jnp.int32).reshape(-1), preds, target

@@ -1,0 +1,405 @@
+"""Retrieval metric tests.
+
+Pattern follows the reference's retrieval helper layer
+(``tests/unittests/retrieval/helpers.py``): streaming metric vs a per-query
+numpy oracle on ALL data; adversarial cases (empty-target queries, every
+``empty_target_action``); plus a shard_map DDP check where each device holds a
+disjoint slice of queries.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+)
+
+SEED = 7
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+N_QUERIES = 6
+
+
+# ------------------------------------------------------------- numpy oracles
+def _np_ap(p, t):
+    order = np.argsort(-p, kind="stable")
+    t = t[order]
+    if t.sum() == 0:
+        return 0.0
+    ranks = np.arange(1, len(t) + 1)
+    hits = np.cumsum(t)
+    return float(np.mean(hits[t > 0] / ranks[t > 0]))
+
+
+def _np_rr(p, t):
+    order = np.argsort(-p, kind="stable")
+    t = t[order]
+    pos = np.nonzero(t)[0]
+    return float(1.0 / (pos[0] + 1)) if len(pos) else 0.0
+
+
+def _np_precision(p, t, k=None, adaptive_k=False):
+    n = len(p)
+    if k is None or (adaptive_k and k > n):
+        k = n
+    order = np.argsort(-p, kind="stable")
+    if t.sum() == 0:
+        return 0.0
+    return float(t[order][: min(k, n)].sum() / k)
+
+
+def _np_recall(p, t, k=None):
+    n = len(p)
+    if k is None:
+        k = n
+    if t.sum() == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][: min(k, n)].sum() / t.sum())
+
+
+def _np_fall_out(p, t, k=None):
+    n = len(p)
+    if k is None:
+        k = n
+    neg = 1 - t
+    if neg.sum() == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")
+    return float(neg[order][: min(k, n)].sum() / neg.sum())
+
+
+def _np_hit_rate(p, t, k=None):
+    n = len(p)
+    if k is None:
+        k = n
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][: min(k, n)].sum() > 0)
+
+
+def _np_r_precision(p, t):
+    r = int(t.sum())
+    if r == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][:r].sum() / r)
+
+
+def _np_dcg(t):
+    return float((t / np.log2(np.arange(len(t)) + 2.0)).sum())
+
+
+def _np_ndcg(p, t, k=None):
+    n = len(p)
+    k = n if k is None else k
+    order = np.argsort(-p, kind="stable")
+    dcg = _np_dcg(t[order][:k].astype(float))
+    idcg = _np_dcg(np.sort(t.astype(float))[::-1][:k])
+    return float(dcg / idcg) if idcg > 0 else 0.0
+
+
+def _np_mean_over_queries(preds, target, indexes, per_query, empty_action="neg", empty_on="pos"):
+    """Group by query, score, apply empty_target_action, mean
+    (mirror of reference ``retrieval/base.py:110-139``)."""
+    scores = []
+    for g in np.unique(indexes):
+        m = indexes == g
+        p, t = preds[m], target[m]
+        empty = (1 - t).sum() == 0 if empty_on == "neg" else t.sum() == 0
+        if empty:
+            if empty_action == "pos":
+                scores.append(1.0)
+            elif empty_action == "neg":
+                scores.append(0.0)
+            elif empty_action == "skip":
+                continue
+        else:
+            scores.append(per_query(p, t))
+    return float(np.mean(scores)) if scores else 0.0
+
+
+def _make_inputs(with_empty_query: bool = False, graded: bool = False):
+    rng = np.random.default_rng(SEED)
+    preds = rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+    indexes = rng.integers(0, N_QUERIES, size=(NUM_BATCHES, BATCH_SIZE))
+    if graded:
+        target = rng.integers(0, 5, size=(NUM_BATCHES, BATCH_SIZE))
+    else:
+        target = rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE))
+    if with_empty_query:
+        # query id N_QUERIES appears with all-zero targets
+        indexes[:, :3] = N_QUERIES
+        target[:, :3] = 0
+    return preds, target, indexes
+
+
+CLASS_CASES = [
+    (RetrievalMAP, {}, _np_ap, "pos"),
+    (RetrievalMRR, {}, _np_rr, "pos"),
+    (RetrievalPrecision, {"k": 3}, lambda p, t: _np_precision(p, t, k=3), "pos"),
+    (
+        RetrievalPrecision,
+        {"k": 40, "adaptive_k": True},
+        lambda p, t: _np_precision(p, t, k=40, adaptive_k=True),
+        "pos",
+    ),
+    (RetrievalRecall, {"k": 3}, lambda p, t: _np_recall(p, t, k=3), "pos"),
+    (RetrievalFallOut, {"k": 3}, lambda p, t: _np_fall_out(p, t, k=3), "neg"),
+    (RetrievalHitRate, {"k": 3}, lambda p, t: _np_hit_rate(p, t, k=3), "pos"),
+    (RetrievalRPrecision, {}, _np_r_precision, "pos"),
+    (RetrievalNormalizedDCG, {}, _np_ndcg, "pos"),
+    (RetrievalNormalizedDCG, {"k": 4}, lambda p, t: _np_ndcg(p, t, k=4), "pos"),
+]
+
+
+@pytest.mark.parametrize("metric_class,args,oracle,empty_on", CLASS_CASES)
+def test_retrieval_class_streaming(metric_class, args, oracle, empty_on):
+    graded = metric_class is RetrievalNormalizedDCG
+    preds, target, indexes = _make_inputs(graded=graded)
+    metric = metric_class(**args)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), jnp.asarray(indexes[i]))
+    expected_action = args.get("empty_target_action", "pos" if metric_class is RetrievalFallOut else "neg")
+    expected = _np_mean_over_queries(
+        preds.reshape(-1), target.reshape(-1), indexes.reshape(-1), oracle,
+        empty_action=expected_action, empty_on=empty_on,
+    )
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+@pytest.mark.parametrize(
+    "metric_class,args,oracle,empty_on",
+    [(RetrievalMAP, {}, _np_ap, "pos"), (RetrievalHitRate, {"k": 3}, lambda p, t: _np_hit_rate(p, t, k=3), "pos")],
+)
+def test_empty_target_actions(metric_class, args, oracle, empty_on, action):
+    preds, target, indexes = _make_inputs(with_empty_query=True)
+    metric = metric_class(empty_target_action=action, **args)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), jnp.asarray(indexes[i]))
+    expected = _np_mean_over_queries(
+        preds.reshape(-1), target.reshape(-1), indexes.reshape(-1), oracle,
+        empty_action=action, empty_on=empty_on,
+    )
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-5)
+
+
+def test_empty_target_error_action():
+    preds, target, indexes = _make_inputs(with_empty_query=True)
+    metric = RetrievalMAP(empty_target_action="error")
+    metric.update(jnp.asarray(preds[0]), jnp.asarray(target[0]), jnp.asarray(indexes[0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        metric.compute()
+
+
+def test_ignore_index():
+    preds, target, indexes = _make_inputs()
+    target = target.copy()
+    target[:, ::5] = -1  # rows to drop
+    metric = RetrievalMAP(ignore_index=-1)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), jnp.asarray(indexes[i]))
+    keep = target.reshape(-1) != -1
+    expected = _np_mean_over_queries(
+        preds.reshape(-1)[keep], target.reshape(-1)[keep], indexes.reshape(-1)[keep], _np_ap
+    )
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-5)
+
+
+FUNCTIONAL_CASES = [
+    (retrieval_average_precision, {}, _np_ap),
+    (retrieval_reciprocal_rank, {}, _np_rr),
+    (retrieval_precision, {"k": 3}, lambda p, t: _np_precision(p, t, k=3)),
+    (retrieval_recall, {"k": 3}, lambda p, t: _np_recall(p, t, k=3)),
+    (retrieval_fall_out, {"k": 3}, lambda p, t: _np_fall_out(p, t, k=3)),
+    (retrieval_hit_rate, {"k": 3}, lambda p, t: _np_hit_rate(p, t, k=3)),
+    (retrieval_r_precision, {}, _np_r_precision),
+    (retrieval_normalized_dcg, {"k": 4}, lambda p, t: _np_ndcg(p, t, k=4)),
+]
+
+
+@pytest.mark.parametrize("fn,kwargs,oracle", FUNCTIONAL_CASES)
+def test_retrieval_functional_single_query(fn, kwargs, oracle):
+    rng = np.random.default_rng(SEED + 1)
+    for trial in range(4):
+        p = rng.random(16).astype(np.float32)
+        t = rng.integers(0, 2, size=16)
+        got = float(fn(jnp.asarray(p), jnp.asarray(t), **kwargs))
+        np.testing.assert_allclose(got, oracle(p, t), atol=1e-5)
+    # no-positive-targets query returns 0
+    p = rng.random(8).astype(np.float32)
+    t = np.zeros(8, dtype=np.int64)
+    assert float(fn(jnp.asarray(p), jnp.asarray(t), **kwargs)) == pytest.approx(
+        oracle(p, t) if fn is retrieval_fall_out else 0.0
+    )
+
+
+def test_retrieval_functional_jits():
+    """Per-query functionals trace under jax.jit (no value-dependent shapes)."""
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.random(16, dtype=np.float32))
+    t = jnp.asarray(rng.integers(0, 2, size=16))
+    fn = jax.jit(lambda a, b: retrieval_average_precision(a, b, validate_args=False))
+    np.testing.assert_allclose(float(fn(p, t)), _np_ap(np.asarray(p), np.asarray(t)), atol=1e-5)
+
+
+def _np_pr_curve(preds, target, indexes, max_k=None, action="neg"):
+    groups = np.unique(indexes)
+    if max_k is None:
+        max_k = max((indexes == g).sum() for g in groups)
+    precisions, recalls = [], []
+    for g in groups:
+        m = indexes == g
+        p, t = preds[m], target[m]
+        if t.sum() == 0:
+            if action == "pos":
+                precisions.append(np.ones(max_k))
+                recalls.append(np.ones(max_k))
+            elif action == "neg":
+                precisions.append(np.zeros(max_k))
+                recalls.append(np.zeros(max_k))
+            continue
+        order = np.argsort(-p, kind="stable")
+        ts = t[order][:max_k].astype(float)
+        rel = np.cumsum(np.pad(ts, (0, max_k - len(ts))))
+        precisions.append(rel / np.arange(1, max_k + 1))
+        recalls.append(rel / t.sum())
+    return np.mean(precisions, axis=0), np.mean(recalls, axis=0), np.arange(1, max_k + 1)
+
+
+@pytest.mark.parametrize("max_k", [None, 3, 10])
+def test_retrieval_pr_curve(max_k):
+    preds, target, indexes = _make_inputs()
+    metric = RetrievalPrecisionRecallCurve(max_k=max_k)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), jnp.asarray(indexes[i]))
+    p, r, k = metric.compute()
+    ep, er, ek = _np_pr_curve(preds.reshape(-1), target.reshape(-1), indexes.reshape(-1), max_k=max_k)
+    np.testing.assert_allclose(np.asarray(p), ep, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(r), er, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(k), ek)
+
+
+def test_retrieval_recall_at_fixed_precision():
+    preds, target, indexes = _make_inputs()
+    metric = RetrievalRecallAtFixedPrecision(min_precision=0.4, max_k=8)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), jnp.asarray(indexes[i]))
+    max_recall, best_k = metric.compute()
+    p, r, k = _np_pr_curve(preds.reshape(-1), target.reshape(-1), indexes.reshape(-1), max_k=8)
+    cands = [(rv, kv) for pv, rv, kv in zip(p, r, k) if pv >= 0.4]
+    exp_recall, exp_k = max(cands) if cands else (0.0, 8)
+    np.testing.assert_allclose(float(max_recall), exp_recall, atol=1e-5)
+    assert int(best_k) == int(exp_k)
+
+
+def test_pr_curve_functional_adaptive_k():
+    rng = np.random.default_rng(3)
+    p = rng.random(5).astype(np.float32)
+    t = rng.integers(0, 2, size=5)
+    t[0] = 1
+    prec, rec, topk = retrieval_precision_recall_curve(jnp.asarray(p), jnp.asarray(t), max_k=8, adaptive_k=True)
+    # beyond n_docs, denominator saturates at n_docs
+    np.testing.assert_array_equal(np.asarray(topk), [1, 2, 3, 4, 5, 5, 5, 5])
+    order = np.argsort(-p, kind="stable")
+    rel = np.cumsum(np.pad(t[order].astype(float), (0, 3)))
+    np.testing.assert_allclose(np.asarray(prec), rel / np.asarray(topk), atol=1e-5)
+
+
+def test_retrieval_ddp_shard_map():
+    """Each device updates on its own slice; all-gather sync must reproduce
+    the all-data oracle on every device (reference test_ddp pattern)."""
+    from metrics_tpu.parallel.backend import AxisBackend
+
+    preds, target, indexes = _make_inputs()
+    metric = RetrievalMAP()
+    n_dev = 2
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("ddp",))
+    preds_all = jnp.asarray(preds)  # (4, B) -> 2 batches per device
+    target_all = jnp.asarray(target)
+    indexes_all = jnp.asarray(indexes)
+
+    def run_sync(p_shard, t_shard, i_shard):
+        state = metric.init_state()
+        for i in range(NUM_BATCHES // n_dev):
+            state = metric.apply_update(state, p_shard[i], t_shard[i], i_shard[i])
+        synced = metric._sync_state_pure(state, AxisBackend("ddp"))
+        return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], synced)
+
+    fn = jax.shard_map(
+        run_sync, mesh=mesh, in_specs=(P("ddp"), P("ddp"), P("ddp")), out_specs=P("ddp"),
+        check_vma=False,
+    )
+    synced = fn(preds_all, target_all, indexes_all)
+    expected = _np_mean_over_queries(
+        preds.reshape(-1), target.reshape(-1), indexes.reshape(-1), _np_ap
+    )
+    for r in range(n_dev):
+        m = RetrievalMAP()
+        rank_state = jax.tree_util.tree_map(lambda x: x[r], synced)
+        for key, val in rank_state.items():
+            m._state[key] = [val]
+        m._update_count = NUM_BATCHES
+        m.sync_on_compute = False
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_retrieval_user_subclass_metric_hook():
+    """The reference-style per-query ``_metric`` extension point still works."""
+    from metrics_tpu.retrieval.base import RetrievalMetric
+
+    class MyHitRate(RetrievalMetric):
+        def _metric(self, preds, target):
+            order = jnp.argsort(-preds)
+            return (target[order][:2].sum() > 0).astype(jnp.float32)
+
+    preds, target, indexes = _make_inputs()
+    metric = MyHitRate()
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), jnp.asarray(indexes[i]))
+    expected = _np_mean_over_queries(
+        preds.reshape(-1), target.reshape(-1), indexes.reshape(-1),
+        lambda p, t: _np_hit_rate(p, t, k=2),
+    )
+    np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-5)
+
+
+def test_retrieval_input_validation():
+    metric = RetrievalMAP()
+    with pytest.raises(ValueError, match="cannot be None"):
+        metric.update(jnp.ones(4), jnp.ones(4, dtype=jnp.int32), None)
+    with pytest.raises(ValueError, match="same shape"):
+        metric.update(jnp.ones(4), jnp.ones(3, dtype=jnp.int32), jnp.zeros(4, dtype=jnp.int32))
+    with pytest.raises(ValueError, match="binary"):
+        metric.update(jnp.ones(4), 5 * jnp.ones(4, dtype=jnp.int32), jnp.zeros(4, dtype=jnp.int32))
+    with pytest.raises(ValueError, match="long integers"):
+        metric.update(jnp.ones(4), jnp.ones(4, dtype=jnp.int32), jnp.zeros(4))
+    with pytest.raises(ValueError, match="empty_target_action"):
+        RetrievalMAP(empty_target_action="bogus")
+    with pytest.raises(ValueError, match="ignore_index"):
+        RetrievalMAP(ignore_index=1.5)
+    with pytest.raises(ValueError, match="positive integer"):
+        RetrievalPrecision(k=-1)
