@@ -1,0 +1,59 @@
+"""error_relative_global_dimensionless_synthesis (reference
+``functional/image/ergas.py``)."""
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import reduce
+
+Array = jax.Array
+
+
+def _ergas_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/type validation (reference ``ergas.py:12-32``)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ergas_per_image(preds: Array, target: Array, ratio: Union[int, float] = 4) -> Array:
+    """Per-image ERGAS, shape ``(B,)`` (reference ``ergas.py:35-70``)."""
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+    return 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS score (reference ``ergas.py:73-126``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(error_relative_global_dimensionless_synthesis(preds, target)) > 0
+        True
+    """
+    preds, target = _ergas_check_inputs(preds, target)
+    return reduce(_ergas_per_image(preds, target, ratio), reduction)

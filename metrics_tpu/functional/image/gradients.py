@@ -1,0 +1,38 @@
+"""image_gradients (reference ``functional/image/gradients.py``)."""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor.")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """1-step finite differences, zero-padded on the far edge
+    (reference ``gradients.py:30-45``)."""
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """(dy, dx) finite-difference gradients of an (N, C, H, W) image batch
+    (reference ``gradients.py:48-81``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, 0, :]
+        Array([5., 5., 5., 5., 5.], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,77 @@
+"""Shared image-metric kernels (reference ``functional/image/helper.py``).
+
+Convolutions are expressed as depthwise ``lax.conv_general_dilated`` so XLA
+maps them onto the MXU; the gaussian window is built as an outer product of 1D
+gaussians (separable, tiny, trace-time constant).
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1D gaussian window, normalized to sum 1 (reference ``helper.py:_gaussian``)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """Per-channel 2D gaussian of shape ``(C, 1, kh, kw)``."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kx.T @ ky  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """Per-channel 3D gaussian of shape ``(C, 1, kd, kh, kw)``."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kz = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = kx.T @ ky  # (kx, ky)
+    kernel = kernel_xy[:, :, None] * kz[0][None, None, :]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def _depthwise_conv(x: Array, kernel: Array) -> Array:
+    """Depthwise VALID conv; ``x``: (B, C, *spatial), ``kernel``: (C, 1, *window)."""
+    channels = x.shape[1]
+    nd = x.ndim - 2
+    if nd == 2:
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NCDHW", "OIDHW", "NCDHW")
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(1,) * nd,
+        padding="VALID",
+        dimension_numbers=dn,
+        feature_group_count=channels,
+    )
+
+
+def _reflection_pad(x: Array, pads: Sequence[int]) -> Array:
+    """Reflect-pad the trailing spatial dims; ``pads`` gives the symmetric pad
+    per spatial dim (reference uses ``F.pad(mode='reflect')`` /
+    ``_reflection_pad_3d``)."""
+    pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(x, pad_width, mode="reflect")
+
+
+def _avg_pool(x: Array, window: int = 2) -> Array:
+    """Non-overlapping average pool over the trailing spatial dims
+    (reference msssim downsampling ``F.avg_pool2d/3d``)."""
+    nd = x.ndim - 2
+    dims = (1, 1) + (window,) * nd
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, dims, "VALID")
+    return summed / (window**nd)

@@ -1,0 +1,58 @@
+"""spectral_angle_mapper (reference ``functional/image/sam.py``)."""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import reduce
+
+Array = jax.Array
+
+
+def _sam_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/type validation (reference ``sam.py:12-37``)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_map(preds: Array, target: Array) -> Array:
+    """Per-pixel spectral angle, shape ``(B, H, W)`` (reference ``sam.py:40-59``)."""
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    return jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+
+
+def spectral_angle_mapper(
+    preds: Array,
+    target: Array,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Spectral angle between pixel spectra (reference ``sam.py:62-120``).
+
+    Example:
+        >>> import jax
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (16, 3, 16, 16))
+        >>> 0 < float(spectral_angle_mapper(preds, target)) < 1.6
+        True
+    """
+    preds, target = _sam_check_inputs(preds, target)
+    return reduce(_sam_map(preds, target), reduction)

@@ -1,0 +1,304 @@
+"""structural_similarity_index_measure + multiscale variant
+(reference ``functional/image/ssim.py``, 487 LoC).
+
+The five sliding-window moments (mu_p, mu_t, E[p^2], E[t^2], E[pt]) are
+computed with ONE depthwise convolution over a stacked ``(5B, C, ...)`` batch —
+the reference's trick, which is also the right shape for the MXU: one large
+conv instead of five small ones.
+"""
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import (
+    _avg_pool,
+    _depthwise_conv,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflection_pad,
+)
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import reduce
+
+Array = jax.Array
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/type validation (reference ``ssim.py:26-46``)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _validate_kernel_sigma(kernel_size: Sequence[int], sigma: Sequence[float], ndim: int) -> None:
+    for name, val in (("kernel_size", kernel_size), ("sigma", sigma)):
+        if len(val) != ndim - 2:
+            raise ValueError(
+                f"`{name}` has dimension {len(val)}, but expected to be two less that target"
+                f" dimensionality, which is: {ndim}"
+            )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+
+def _ssim_per_image(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Per-image SSIM scores, shape ``(B,)`` (reference ``ssim.py:49-199``
+    before the final reduction)."""
+    is_3d = preds.ndim == 5
+    nd = preds.ndim - 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = nd * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = nd * [sigma]
+    _validate_kernel_sigma(kernel_size, sigma, preds.ndim)
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    channel = preds.shape[1]
+    dtype = preds.dtype
+    # the gaussian window size is derived from sigma (reference ssim.py:139)
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    pads = [(k - 1) // 2 for k in gauss_kernel_size]
+
+    preds = _reflection_pad(preds, pads)
+    target = _reflection_pad(target, pads)
+    if gaussian_kernel:
+        make = _gaussian_kernel_3d if is_3d else _gaussian_kernel_2d
+        kernel = make(channel, gauss_kernel_size, sigma, dtype)
+    else:
+        size = 1
+        for k in kernel_size:
+            size *= k
+        kernel = jnp.broadcast_to(
+            jnp.ones(tuple(kernel_size), dtype=dtype) / size, (channel, 1, *kernel_size)
+        )
+
+    batch = preds.shape[0]
+    stacked = jnp.concatenate(
+        (preds, target, preds * preds, target * target, preds * target)
+    )  # (5B, C, ...)
+    out = _depthwise_conv(stacked, kernel)
+    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (
+        out[i * batch : (i + 1) * batch] for i in range(5)
+    )
+
+    mu_pred_sq = jnp.square(mu_pred)
+    mu_target_sq = jnp.square(mu_target)
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+    ssim_full = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    # crop each dim's pad-influenced border (reference ssim.py:182-185)
+    crop = (Ellipsis,) + tuple(slice(p, -p if p > 0 else None) for p in pads)
+    ssim_idx = ssim_full[crop]
+    per_image = ssim_idx.reshape(batch, -1).mean(-1)
+
+    if return_contrast_sensitivity:
+        cs = (upper / lower)[crop]
+        return per_image, cs.reshape(batch, -1).mean(-1)
+    if return_full_image:
+        return per_image, ssim_full
+    return per_image
+
+
+def _ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    out = _ssim_per_image(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+    if return_contrast_sensitivity or return_full_image:
+        per_image, second = out
+        return reduce(per_image, reduction), reduce(second, reduction)
+    return reduce(out, reduction)
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """SSIM between image batches (reference ``ssim.py:202-271``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(structural_similarity_index_measure(preds, target)) > 0.9
+        True
+    """
+    preds, target = _ssim_check_inputs(preds, target)
+    return _ssim_compute(
+        preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range,
+        k1, k2, return_full_image, return_contrast_sensitivity,
+    )
+
+
+def _multiscale_ssim_stacks(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+) -> Tuple[Array, Array]:
+    """Raw per-scale, per-image (sim, cs) stacks of shape ``(S, B)``
+    (reference ``ssim.py:296-417`` before reduction/normalization)."""
+    nd = preds.ndim - 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = nd * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = nd * [sigma]
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width"
+            f" dimensions must be larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size"
+            f" {kernel_size[0]}, the image height must be larger than"
+            f" {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size"
+            f" {kernel_size[1]}, the image width must be larger than"
+            f" {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    sims, css = [], []
+    for _ in range(len(betas)):
+        sim, cs = _ssim_per_image(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+            return_contrast_sensitivity=True,
+        )
+        sims.append(sim)
+        css.append(cs)
+        preds = _avg_pool(preds)
+        target = _avg_pool(target)
+    return jnp.stack(sims), jnp.stack(css)  # (S, B) each
+
+
+def _msssim_combine(
+    sim_stack: Array,
+    cs_stack: Array,
+    betas: Tuple[float, ...],
+    reduction: Optional[str],
+    normalize: Optional[str],
+) -> Array:
+    """Normalize, reduce over the batch axis, and combine scales
+    (reference ``ssim.py:286-293, 405-417``).
+
+    The reference reduces sim/cs over the batch at EVERY scale before the
+    beta-weighted product (``_get_normalized_sim_and_cs`` receives the
+    already-reduced value), so for mean/sum the result is a function of the
+    per-scale batch statistics, not a mean of per-image products.
+    """
+    if reduction in ("none", None):
+        pass  # keep (S, B)
+    elif reduction == "sum":
+        sim_stack, cs_stack = sim_stack.sum(axis=1), cs_stack.sum(axis=1)
+    else:
+        sim_stack, cs_stack = sim_stack.mean(axis=1), cs_stack.mean(axis=1)
+    if normalize == "relu":
+        sim_stack, cs_stack = jax.nn.relu(sim_stack), jax.nn.relu(cs_stack)
+    elif normalize == "simple":
+        sim_stack = (sim_stack + 1) / 2
+        cs_stack = (cs_stack + 1) / 2
+    betas_arr = jnp.asarray(betas).reshape((-1,) + (1,) * (sim_stack.ndim - 1))
+    sim_stack = sim_stack**betas_arr
+    cs_stack = cs_stack**betas_arr
+    return jnp.prod(cs_stack[:-1], axis=0) * sim_stack[-1]
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """Multi-scale SSIM (reference ``ssim.py:420-487``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (1, 1, 256, 256))
+        >>> target = preds * 0.75
+        >>> float(multiscale_structural_similarity_index_measure(preds, target)) > 0.9
+        True
+    """
+    if not isinstance(betas, tuple):
+        raise ValueError("Argument `betas` is expected to be of a type tuple.")
+    if not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be a tuple of floats.")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_check_inputs(preds, target)
+    sim_stack, cs_stack = _multiscale_ssim_stacks(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas
+    )
+    return _msssim_combine(sim_stack, cs_stack, betas, reduction, normalize)

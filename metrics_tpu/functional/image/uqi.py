@@ -1,0 +1,99 @@
+"""universal_image_quality_index (reference ``functional/image/uqi.py``)."""
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflection_pad
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import reduce
+
+Array = jax.Array
+
+
+def _uqi_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/type validation (reference ``uqi.py:13-33``)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _uqi_map(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+) -> Array:
+    """Per-pixel UQI map of shape ``(B, C, H', W')``
+    (reference ``uqi.py:36-113`` before the reduction)."""
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds = _reflection_pad(preds, (pad_h, pad_w))
+    target = _reflection_pad(target, (pad_h, pad_w))
+
+    batch = preds.shape[0]
+    stacked = jnp.concatenate((preds, target, preds * preds, target * target, preds * target))
+    out = _depthwise_conv(stacked, kernel)
+    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (
+        out[i * batch : (i + 1) * batch] for i in range(5)
+    )
+
+    mu_pred_sq = jnp.square(mu_pred)
+    mu_target_sq = jnp.square(mu_target)
+    mu_pred_target = mu_pred * mu_target
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower)
+    # crop each dim's pad-influenced border independently
+    return uqi_idx[
+        ..., slice(pad_h, -pad_h if pad_h > 0 else None), slice(pad_w, -pad_w if pad_w > 0 else None)
+    ]
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> Array:
+    """UQI between image batches (reference ``uqi.py:116-180``).
+    ``data_range`` is accepted for API parity; the UQI formula has no
+    stabilization constants, so it is unused (as in the reference math).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(universal_image_quality_index(preds, target)) > 0.9
+        True
+    """
+    preds, target = _uqi_check_inputs(preds, target)
+    return reduce(_uqi_map(preds, target, kernel_size, sigma), reduction)

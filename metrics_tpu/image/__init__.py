@@ -1,0 +1,21 @@
+"""Image metrics (reference ``src/torchmetrics/image/``)."""
+
+from metrics_tpu.image.d_lambda import SpectralDistortionIndex
+from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio
+from metrics_tpu.image.sam import SpectralAngleMapper
+from metrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from metrics_tpu.image.uqi import UniversalImageQualityIndex
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex",
+]

@@ -1,0 +1,58 @@
+"""ErrorRelativeGlobalDimensionlessSynthesis (reference ``image/ergas.py:26-99``).
+
+Constant-memory delta: per-image ERGAS scores are computed in the jitted
+``update``; only their sum and count are kept (the reference stores full
+preds/target lists, ``ergas.py:79-80``).
+"""
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.ergas import _ergas_check_inputs, _ergas_per_image
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+_VALID_REDUCTIONS = ("elementwise_mean", "sum", "none", None)
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        ratio: Union[int, float] = 4,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if reduction not in _VALID_REDUCTIONS:
+            raise ValueError("Reduction parameter unknown.")
+        self.ratio = ratio
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("score", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ergas_check_inputs(preds, target)
+        per_image = _ergas_per_image(preds, target, self.ratio)
+        if self.reduction in ("none", None):
+            self.score.append(per_image)
+        else:
+            self.score_sum = self.score_sum + per_image.sum()
+            self.total = self.total + per_image.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.score)
+        if self.reduction == "sum":
+            return self.score_sum
+        return self.score_sum / self.total

@@ -1,0 +1,52 @@
+"""SpectralAngleMapper (reference ``image/sam.py:25-94``).
+
+Constant-memory delta: the per-pixel angle map is reduced to (sum, count)
+inside the jitted ``update`` (the reference stores full preds/target lists,
+``sam.py:75-76``).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.sam import _sam_check_inputs, _sam_map
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+_VALID_REDUCTIONS = ("elementwise_mean", "sum", "none", None)
+
+
+class SpectralAngleMapper(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in _VALID_REDUCTIONS:
+            raise ValueError("Reduction parameter unknown.")
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("score", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _sam_check_inputs(preds, target)
+        sam_map = _sam_map(preds, target)
+        if self.reduction in ("none", None):
+            self.score.append(sam_map)
+        else:
+            self.score_sum = self.score_sum + sam_map.sum()
+            self.total = self.total + sam_map.size
+
+    def compute(self) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.score)
+        if self.reduction == "sum":
+            return self.score_sum
+        return self.score_sum / self.total

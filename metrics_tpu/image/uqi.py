@@ -1,0 +1,60 @@
+"""UniversalImageQualityIndex (reference ``image/uqi.py:25-98``).
+
+TPU-first delta: the reference stores full preds/target lists
+(``uqi.py:76-77``); UQI's final value is a mean over the per-pixel UQI map,
+which decomposes exactly over batches — so only ``(sum, count)`` is kept.
+"""
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.uqi import _uqi_check_inputs, _uqi_map
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+_VALID_REDUCTIONS = ("elementwise_mean", "sum", "none", None)
+
+
+class UniversalImageQualityIndex(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if reduction not in _VALID_REDUCTIONS:
+            raise ValueError("Reduction parameter unknown.")
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("score", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _uqi_check_inputs(preds, target)
+        uqi_map = _uqi_map(preds, target, self.kernel_size, self.sigma)
+        if self.reduction in ("none", None):
+            self.score.append(uqi_map)
+        else:
+            self.score_sum = self.score_sum + uqi_map.sum()
+            self.total = self.total + uqi_map.size
+
+    def compute(self) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.score)
+        if self.reduction == "sum":
+            return self.score_sum
+        return self.score_sum / self.total

@@ -154,3 +154,14 @@ def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool
     if a.shape != b.shape:
         return False
     return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))
+
+
+def reduce(x: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """Reduce a score tensor (reference ``utilities/distributed.py:22-44``)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Reduction parameter unknown.")

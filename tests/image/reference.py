@@ -1,0 +1,131 @@
+"""Hand-written numpy/scipy oracles for image metrics.
+
+Independent implementations (scipy.signal sliding windows) mirroring the
+published formulas — the role the reference suite gives to scikit-image /
+pytorch_msssim (``tests/unittests/image/``).
+
+Key identity used throughout: reflect-pad + VALID conv + crop-by-pad (the
+reference pipeline) is exactly a VALID window over the original image.
+"""
+
+import numpy as np
+from scipy import signal
+
+
+def np_gaussian_kernel(sigma, size):
+    dist = np.arange((1 - size) / 2, (1 + size) / 2)
+    g = np.exp(-((dist / sigma) ** 2) / 2)
+    g = g / g.sum()
+    return np.outer(g, g)
+
+
+def _valid_window_means(img, kernel):
+    """Windowed means of img (H, W) under kernel, VALID positions only."""
+    return signal.convolve2d(img, kernel[::-1, ::-1], mode="valid")
+
+
+def np_ssim_per_image(pred, target, data_range, sigma=1.5, k1=0.01, k2=0.03):
+    """Per-image SSIM mean for (C, H, W) arrays, gaussian window."""
+    size = int(3.5 * sigma + 0.5) * 2 + 1
+    kernel = np_gaussian_kernel(sigma, size)
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    vals, css = [], []
+    for c in range(pred.shape[0]):
+        p, t = pred[c], target[c]
+        mu_p = _valid_window_means(p, kernel)
+        mu_t = _valid_window_means(t, kernel)
+        e_pp = _valid_window_means(p * p, kernel)
+        e_tt = _valid_window_means(t * t, kernel)
+        e_pt = _valid_window_means(p * t, kernel)
+        s_pp = e_pp - mu_p**2
+        s_tt = e_tt - mu_t**2
+        s_pt = e_pt - mu_p * mu_t
+        upper = 2 * s_pt + c2
+        lower = s_pp + s_tt + c2
+        ssim_map = ((2 * mu_p * mu_t + c1) * upper) / ((mu_p**2 + mu_t**2 + c1) * lower)
+        vals.append(ssim_map)
+        css.append(upper / lower)
+    return np.mean(vals), np.mean(css)
+
+
+def np_msssim_per_image(pred, target, data_range, sigma=1.5,
+                        betas=(0.0448, 0.2856, 0.3001, 0.2363, 0.1333), normalize="relu"):
+    """Per-image MS-SSIM for (C, H, W) arrays."""
+    sims, css = [], []
+    p, t = pred.astype(np.float64), target.astype(np.float64)
+    for _ in betas:
+        sim, cs = np_ssim_per_image(p, t, data_range, sigma=sigma)
+        if normalize == "relu":
+            sim, cs = max(sim, 0.0), max(cs, 0.0)
+        sims.append(sim)
+        css.append(cs)
+        # 2x2 avg pool
+        c, h, w = p.shape
+        p = p[:, : h // 2 * 2, : w // 2 * 2].reshape(c, h // 2, 2, w // 2, 2).mean((2, 4))
+        t = t[:, : h // 2 * 2, : w // 2 * 2].reshape(c, h // 2, 2, w // 2, 2).mean((2, 4))
+    sims = np.asarray(sims) ** np.asarray(betas)
+    css = np.asarray(css) ** np.asarray(betas)
+    return np.prod(css[:-1]) * sims[-1]
+
+
+def np_uqi_map(pred, target, sigma=1.5, size=11):
+    """Full-dataset UQI map mean for (N, C, H, W) arrays."""
+    kernel = np_gaussian_kernel(sigma, size)
+    maps = []
+    for n in range(pred.shape[0]):
+        for c in range(pred.shape[1]):
+            p, t = pred[n, c], target[n, c]
+            mu_p = _valid_window_means(p, kernel)
+            mu_t = _valid_window_means(t, kernel)
+            e_pp = _valid_window_means(p * p, kernel)
+            e_tt = _valid_window_means(t * t, kernel)
+            e_pt = _valid_window_means(p * t, kernel)
+            s_pp = e_pp - mu_p**2
+            s_tt = e_tt - mu_t**2
+            s_pt = e_pt - mu_p * mu_t
+            maps.append(((2 * mu_p * mu_t) * (2 * s_pt)) / ((mu_p**2 + mu_t**2) * (s_pp + s_tt)))
+    return np.asarray(maps)
+
+
+def np_uqi(pred, target):
+    return float(np_uqi_map(pred, target).mean())
+
+
+def np_d_lambda(pred, target, p=1):
+    """Spectral distortion index for (N, C, H, W) arrays."""
+    length = pred.shape[1]
+    m1 = np.zeros((length, length))
+    m2 = np.zeros((length, length))
+    for k in range(length):
+        for r in range(k, length):
+            m1[k, r] = m1[r, k] = np_uqi(target[:, k : k + 1], target[:, r : r + 1])
+            m2[k, r] = m2[r, k] = np_uqi(pred[:, k : k + 1], pred[:, r : r + 1])
+    diff = np.abs(m1 - m2) ** p
+    if length == 1:
+        return float(diff ** (1.0 / p))
+    return float((diff.sum() / (length * (length - 1))) ** (1.0 / p))
+
+
+def np_ergas(pred, target, ratio=4):
+    """Mean per-image ERGAS for (N, C, H, W) arrays."""
+    n, c, h, w = pred.shape
+    p = pred.reshape(n, c, -1)
+    t = target.reshape(n, c, -1)
+    rmse = np.sqrt(((p - t) ** 2).sum(-1) / (h * w))
+    mean_t = t.mean(-1)
+    return float(np.mean(100 * ratio * np.sqrt(((rmse / mean_t) ** 2).sum(1) / c)))
+
+
+def np_sam(pred, target):
+    """Mean spectral angle for (N, C, H, W) arrays."""
+    dot = (pred * target).sum(1)
+    norm = np.linalg.norm(pred, axis=1) * np.linalg.norm(target, axis=1)
+    return float(np.arccos(np.clip(dot / norm, -1, 1)).mean())
+
+
+def np_psnr(pred, target, data_range=None, base=10.0):
+    if data_range is None:
+        data_range = target.max() - target.min()
+    mse = ((pred - target) ** 2).mean()
+    return float((2 * np.log(data_range) - np.log(mse)) * 10 / np.log(base))

@@ -1,0 +1,334 @@
+"""Tests for the pure-tensor image metrics (PSNR/SSIM/MS-SSIM/UQI/D-lambda/
+ERGAS/SAM/image_gradients) against independent scipy oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    universal_image_quality_index,
+)
+from metrics_tpu.image import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
+from tests.helpers.testers import MetricTester
+from tests.image.reference import (
+    np_d_lambda,
+    np_ergas,
+    np_msssim_per_image,
+    np_psnr,
+    np_sam,
+    np_ssim_per_image,
+    np_uqi,
+)
+
+SEED = 11
+NUM_BATCHES = 4
+BATCH = 4
+
+
+def _images(channels=3, size=16, hi=1.0):
+    rng = np.random.default_rng(SEED)
+    preds = rng.random((NUM_BATCHES, BATCH, channels, size, size), dtype=np.float32) * hi
+    target = rng.random((NUM_BATCHES, BATCH, channels, size, size), dtype=np.float32) * hi
+    return preds, target
+
+
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _images()
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=peak_signal_noise_ratio,
+            reference_fn=lambda p, t: np_psnr(p, t),
+        )
+
+    def test_class_streaming_and_ddp(self):
+        preds, target = _images()
+        self.run_class_metric_test(
+            preds, target,
+            metric_class=PeakSignalNoiseRatio,
+            reference_fn=lambda p, t: np_psnr(p, t, data_range=1.0),
+            metric_args={"data_range": 1.0},
+            ddp=True,
+        )
+
+    def test_running_minmax_range(self):
+        """data_range=None tracks global target min/max, clamped to span 0
+        (reference image/psnr.py:99-100 initializes the trackers at 0)."""
+        preds, target = _images(hi=4.0)
+        target = target + 1.0  # targets in [1, 5]: exposes the 0-clamp
+        metric = PeakSignalNoiseRatio()
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        all_p = preds.reshape(-1)
+        all_t = target.reshape(-1)
+        data_range = max(all_t.max(), 0.0) - min(all_t.min(), 0.0)
+        np.testing.assert_allclose(
+            float(metric.compute()), np_psnr(all_p, all_t, data_range=data_range), atol=1e-4
+        )
+
+    def test_dim_list_states(self):
+        preds, target = _images()
+        metric = PeakSignalNoiseRatio(data_range=1.0, dim=(1, 2, 3), reduction="elementwise_mean")
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        per_image_mse = ((preds - target) ** 2).mean(axis=(2, 3, 4)).reshape(-1)
+        expected = np.mean((2 * np.log(1.0) - np.log(per_image_mse)) * 10 / np.log(10.0))
+        np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-4)
+
+
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _images()
+
+        def oracle(p, t):
+            return np.mean([np_ssim_per_image(p[i], t[i], data_range=1.0)[0] for i in range(len(p))])
+
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=structural_similarity_index_measure,
+            reference_fn=oracle,
+            metric_args={"data_range": 1.0},
+        )
+
+    def test_contrast_sensitivity_and_full_image(self):
+        preds, target = _images(channels=1)
+        p, t = jnp.asarray(preds[0]), jnp.asarray(target[0])
+        s, cs = structural_similarity_index_measure(
+            p, t, data_range=1.0, return_contrast_sensitivity=True
+        )
+        exp = [np_ssim_per_image(preds[0][i], target[0][i], 1.0) for i in range(BATCH)]
+        np.testing.assert_allclose(float(s), np.mean([e[0] for e in exp]), atol=1e-4)
+        np.testing.assert_allclose(float(cs), np.mean([e[1] for e in exp]), atol=1e-4)
+        s2, full = structural_similarity_index_measure(
+            p, t, data_range=1.0, return_full_image=True, reduction="none"
+        )
+        assert full.shape[0] == BATCH
+
+    def test_class_streaming_and_ddp(self):
+        preds, target = _images()
+
+        def oracle(p, t):
+            return np.mean([np_ssim_per_image(p[i], t[i], data_range=1.0)[0] for i in range(len(p))])
+
+        self.run_class_metric_test(
+            preds, target,
+            metric_class=StructuralSimilarityIndexMeasure,
+            reference_fn=oracle,
+            metric_args={"data_range": 1.0},
+            ddp=True,
+        )
+
+    def test_reduction_none(self):
+        preds, target = _images()
+        metric = StructuralSimilarityIndexMeasure(data_range=1.0, reduction="none")
+        for i in range(2):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        out = metric.compute()
+        assert out.shape == (2 * BATCH,)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="odd positive"):
+            structural_similarity_index_measure(
+                jnp.ones((1, 1, 8, 8)), jnp.ones((1, 1, 8, 8)), gaussian_kernel=False, kernel_size=4
+            )
+        with pytest.raises(ValueError, match="BxCxHxW"):
+            structural_similarity_index_measure(jnp.ones((8, 8)), jnp.ones((8, 8)))
+        with pytest.raises(TypeError, match="same data type"):
+            structural_similarity_index_measure(
+                jnp.ones((1, 1, 8, 8)), jnp.ones((1, 1, 8, 8), dtype=jnp.float16)
+            )
+
+
+class TestMSSSIM(MetricTester):
+    atol = 1e-3
+
+    def test_functional(self):
+        rng = np.random.default_rng(SEED)
+        preds = rng.random((2, 1, 1, 176, 176), dtype=np.float32)
+        target = np.clip(preds * 0.8 + 0.1 * rng.random((2, 1, 1, 176, 176), dtype=np.float32), 0, 1)
+
+        def oracle(p, t):
+            return np.mean([np_msssim_per_image(p[i], t[i], data_range=1.0) for i in range(len(p))])
+
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=multiscale_structural_similarity_index_measure,
+            reference_fn=oracle,
+            metric_args={"data_range": 1.0},
+        )
+
+    def test_class_streaming(self):
+        rng = np.random.default_rng(SEED + 1)
+        preds = rng.random((2, 1, 1, 176, 176), dtype=np.float32)
+        target = np.clip(preds * 0.8, 0, 1)
+        metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        for i in range(2):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        expected = np.mean(
+            [np_msssim_per_image(preds.reshape(-1, 1, 176, 176)[i], target.reshape(-1, 1, 176, 176)[i], 1.0)
+             for i in range(2)]
+        )
+        np.testing.assert_allclose(float(metric.compute()), expected, atol=1e-3)
+
+    def test_batch_reduction_semantics(self):
+        """MS-SSIM reduces sim/cs over the batch at each scale BEFORE the
+        beta product (reference ssim.py:405-413) — not mean-of-per-image."""
+        rng = np.random.default_rng(SEED + 2)
+        preds = rng.random((2, 1, 176, 176), dtype=np.float32)
+        target = np.stack([np.clip(preds[0] * 0.95, 0, 1), rng.random((1, 176, 176), dtype=np.float32)])
+        got = float(
+            multiscale_structural_similarity_index_measure(
+                jnp.asarray(preds), jnp.asarray(target), data_range=1.0
+            )
+        )
+        # oracle: per-scale batch means, then beta-weighted product
+        from tests.image.reference import np_gaussian_kernel, np_ssim_per_image
+
+        betas = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333)
+        p, t = preds.astype(np.float64), target.astype(np.float64)
+        sims, css = [], []
+        for _ in betas:
+            vals = [np_ssim_per_image(p[i], t[i], 1.0) for i in range(2)]
+            sims.append(np.mean([v[0] for v in vals]))
+            css.append(np.mean([v[1] for v in vals]))
+            n, c, h, w = p.shape
+            p = p[:, :, : h // 2 * 2, : w // 2 * 2].reshape(n, c, h // 2, 2, w // 2, 2).mean((3, 5))
+            t = t[:, :, : h // 2 * 2, : w // 2 * 2].reshape(n, c, h // 2, 2, w // 2, 2).mean((3, 5))
+        sims = np.asarray(sims) ** np.asarray(betas)
+        css = np.asarray(css) ** np.asarray(betas)
+        expected = float(np.prod(css[:-1]) * sims[-1])
+        np.testing.assert_allclose(got, expected, atol=1e-3)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="betas"):
+            multiscale_structural_similarity_index_measure(
+                jnp.ones((1, 1, 176, 176)), jnp.ones((1, 1, 176, 176)), betas=[0.5]
+            )
+        with pytest.raises(ValueError, match="larger than or equal"):
+            multiscale_structural_similarity_index_measure(
+                jnp.ones((1, 1, 16, 16)), jnp.ones((1, 1, 16, 16))
+            )
+
+
+class TestUQI(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _images()
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=universal_image_quality_index,
+            reference_fn=np_uqi,
+        )
+
+    def test_class_streaming_and_ddp(self):
+        preds, target = _images()
+        self.run_class_metric_test(
+            preds, target,
+            metric_class=UniversalImageQualityIndex,
+            reference_fn=np_uqi,
+            ddp=True,
+        )
+
+
+class TestDLambda(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _images(channels=3)
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=spectral_distortion_index,
+            reference_fn=np_d_lambda,
+        )
+
+    def test_class_streaming(self):
+        """The streaming (C,C)-sum state must equal the all-data oracle."""
+        preds, target = _images(channels=3)
+        metric = SpectralDistortionIndex()
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        all_p = preds.reshape(-1, *preds.shape[2:])
+        all_t = target.reshape(-1, *target.shape[2:])
+        np.testing.assert_allclose(float(metric.compute()), np_d_lambda(all_p, all_t), atol=1e-4)
+
+    def test_single_channel(self):
+        preds, target = _images(channels=1)
+        val = spectral_distortion_index(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        np.testing.assert_allclose(float(val), np_d_lambda(preds[0], target[0]), atol=1e-4)
+
+
+class TestERGAS(MetricTester):
+    atol = 1e-2  # ERGAS values are O(1e2); rtol dominates
+
+    def test_functional(self):
+        preds, target = _images()
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=error_relative_global_dimensionless_synthesis,
+            reference_fn=np_ergas,
+        )
+
+    def test_class_streaming_and_ddp(self):
+        preds, target = _images()
+        self.run_class_metric_test(
+            preds, target,
+            metric_class=ErrorRelativeGlobalDimensionlessSynthesis,
+            reference_fn=np_ergas,
+            ddp=True,
+        )
+
+
+class TestSAM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _images()
+        self.run_functional_metric_test(
+            preds, target,
+            metric_functional=spectral_angle_mapper,
+            reference_fn=np_sam,
+        )
+
+    def test_class_streaming_and_ddp(self):
+        preds, target = _images()
+        self.run_class_metric_test(
+            preds, target,
+            metric_class=SpectralAngleMapper,
+            reference_fn=np_sam,
+            ddp=True,
+        )
+
+    def test_single_channel_raises(self):
+        with pytest.raises(ValueError, match="larger than 1"):
+            spectral_angle_mapper(jnp.ones((2, 1, 8, 8)), jnp.ones((2, 1, 8, 8)))
+
+
+def test_image_gradients():
+    image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(image)
+    assert dy.shape == dx.shape == (1, 1, 5, 5)
+    np.testing.assert_allclose(np.asarray(dy[0, 0, :4]), np.full((4, 5), 5.0))
+    np.testing.assert_allclose(np.asarray(dy[0, 0, 4]), np.zeros(5))
+    np.testing.assert_allclose(np.asarray(dx[0, 0, :, :4]), np.full((5, 4), 1.0))
+    with pytest.raises(RuntimeError, match="4D"):
+        image_gradients(jnp.ones((5, 5)))
