@@ -1,0 +1,256 @@
+"""On-demand-built C++ host kernels (ctypes), with pure-Python fallbacks.
+
+The TPU compute path is XLA; these cover the host-sequential algorithms the
+reference keeps in pure Python (edit distance,
+``functional/text/helper.py:333-354``) or buys from third-party C extensions
+(pycocotools RLE masks, ``detection/mean_ap.py:127-142``).  The shared library
+is compiled with ``g++ -O3`` on first use and cached next to this file; if no
+toolchain is available everything silently falls back to Python.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_lib() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and load the shared library; None on any failure."""
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_HERE, f"_native_{digest}.so")
+        if not os.path.exists(so_path):
+            tmp = so_path + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.mtpu_edit_distance.restype = ctypes.c_int64
+        lib.mtpu_edit_distance.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
+        lib.mtpu_edit_distance_batch.restype = None
+        lib.mtpu_edit_distance_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.mtpu_rle_encode.restype = ctypes.c_int64
+        lib.mtpu_rle_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.mtpu_rle_decode.restype = None
+        lib.mtpu_rle_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ]
+        lib.mtpu_rle_area.restype = ctypes.c_int64
+        lib.mtpu_rle_area.argtypes = [ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
+        lib.mtpu_rle_intersection.restype = ctypes.c_int64
+        lib.mtpu_rle_intersection.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+        ]
+        return lib
+    except Exception:
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if not _TRIED:
+            _LIB = _build_lib()
+            _TRIED = True
+    return _LIB
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# Edit distance
+# ---------------------------------------------------------------------------
+def _intern(*seqs: Sequence[str]) -> List[np.ndarray]:
+    table: dict = {}
+    out = []
+    for seq in seqs:
+        ids = np.empty(len(seq), dtype=np.int64)
+        for i, tok in enumerate(seq):
+            ids[i] = table.setdefault(tok, len(table))
+        out.append(ids)
+    return out
+
+
+def _edit_distance_py(a: np.ndarray, b: np.ndarray) -> int:
+    """Two-row DP fallback (vectorized inner loop over numpy)."""
+    na, nb = len(a), len(b)
+    if na == 0:
+        return nb
+    if nb == 0:
+        return na
+    prev = np.arange(nb + 1, dtype=np.int64)
+    for i in range(1, na + 1):
+        cur = np.empty(nb + 1, dtype=np.int64)
+        cur[0] = i
+        sub = prev[:-1] + (a[i - 1] != b)
+        dele = prev[1:] + 1
+        best = np.minimum(sub, dele)
+        # insertion column carries a sequential dependency; resolve with a
+        # running-min scan: cur[j] = min(best[j-1], cur[j-1]+1)
+        run = cur[0]
+        for j in range(1, nb + 1):
+            run = min(run + 1, best[j - 1])
+            cur[j] = run
+        prev = cur
+    return int(prev[nb])
+
+
+def edit_distance(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
+    """Levenshtein distance between two token sequences (words or chars)."""
+    a, b = _intern(pred_tokens, target_tokens)
+    lib = get_lib()
+    if lib is not None:
+        return int(
+            lib.mtpu_edit_distance(
+                a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(a),
+                b.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(b),
+            )
+        )
+    return _edit_distance_py(a, b)
+
+
+def edit_distance_batch(
+    preds: Sequence[Sequence[str]], targets: Sequence[Sequence[str]]
+) -> np.ndarray:
+    """Per-pair Levenshtein distances in one native call."""
+    assert len(preds) == len(targets)
+    n = len(preds)
+    lib = get_lib()
+    if lib is None or n == 0:
+        return np.asarray(
+            [edit_distance(p, t) for p, t in zip(preds, targets)], dtype=np.int64
+        )
+    interned = _intern(*preds, *targets)
+    a_ids, b_ids = interned[:n], interned[n:]
+    a_flat = np.concatenate(a_ids) if a_ids else np.empty(0, np.int64)
+    b_flat = np.concatenate(b_ids) if b_ids else np.empty(0, np.int64)
+    a_flat = np.ascontiguousarray(a_flat, dtype=np.int64)
+    b_flat = np.ascontiguousarray(b_flat, dtype=np.int64)
+    a_lens = np.asarray([len(x) for x in a_ids], dtype=np.int64)
+    b_lens = np.asarray([len(x) for x in b_ids], dtype=np.int64)
+    out = np.empty(n, dtype=np.int64)
+    lib.mtpu_edit_distance_batch(
+        a_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        a_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        b_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        b_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RLE masks (COCO column-major convention)
+# ---------------------------------------------------------------------------
+def rle_encode(mask: np.ndarray) -> np.ndarray:
+    """Binary HxW mask -> uncompressed RLE counts (column-major, 0-run first)."""
+    mask = np.ascontiguousarray(np.asfortranarray(mask.astype(np.uint8)).ravel(order="F"))
+    h, w = 0, 0  # only total length matters to the kernel
+    lib = get_lib()
+    if lib is not None:
+        counts = np.empty(mask.size + 1, dtype=np.uint32)
+        n_runs = lib.mtpu_rle_encode(
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            mask.size, 1,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        return counts[:n_runs].copy()
+    # python fallback
+    flat = mask
+    if flat.size == 0:
+        return np.asarray([0], dtype=np.uint32)
+    change = np.flatnonzero(np.diff(flat)) + 1
+    bounds = np.concatenate([[0], change, [flat.size]])
+    runs = np.diff(bounds).astype(np.uint32)
+    if flat[0] == 1:
+        runs = np.concatenate([[np.uint32(0)], runs])
+    return runs
+
+
+def rle_decode(counts: np.ndarray, shape: tuple) -> np.ndarray:
+    """Uncompressed RLE counts -> binary mask of `shape` (column-major)."""
+    n = int(np.prod(shape))
+    counts = np.ascontiguousarray(counts, dtype=np.uint32)
+    lib = get_lib()
+    if lib is not None:
+        flat = np.empty(n, dtype=np.uint8)
+        lib.mtpu_rle_decode(
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(counts),
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n,
+        )
+    else:
+        flat = np.zeros(n, dtype=np.uint8)
+        pos, v = 0, 0
+        for c in counts:
+            end = min(pos + int(c), n)
+            if v:
+                flat[pos:end] = 1
+            pos = end
+            v = 1 - v
+    return flat.reshape(shape, order="F")
+
+
+def rle_area(counts: np.ndarray) -> int:
+    counts = np.ascontiguousarray(counts, dtype=np.uint32)
+    lib = get_lib()
+    if lib is not None:
+        return int(lib.mtpu_rle_area(counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(counts)))
+    return int(counts[1::2].sum())
+
+
+def rle_iou(a: np.ndarray, b: np.ndarray, iscrowd_b: bool = False) -> float:
+    """IoU of two RLE masks over the same canvas; crowd GT uses area(a) denom."""
+    a = np.ascontiguousarray(a, dtype=np.uint32)
+    b = np.ascontiguousarray(b, dtype=np.uint32)
+    lib = get_lib()
+    if lib is not None:
+        inter = int(
+            lib.mtpu_rle_intersection(
+                a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(a),
+                b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(b),
+            )
+        )
+    else:
+        pos_a = np.cumsum(a)
+        pos_b = np.cumsum(b)
+        n = int(min(pos_a[-1] if len(pos_a) else 0, pos_b[-1] if len(pos_b) else 0))
+        ma = rle_decode(a, (n,)) if n else np.zeros(0, np.uint8)
+        mb = rle_decode(b, (n,)) if n else np.zeros(0, np.uint8)
+        inter = int(np.logical_and(ma, mb).sum())
+    area_a, area_b = rle_area(a), rle_area(b)
+    denom = area_a if iscrowd_b else (area_a + area_b - inter)
+    return inter / denom if denom > 0 else 0.0

@@ -1,0 +1,127 @@
+"""BLEU score (reference ``functional/text/bleu.py:26-230``).
+
+Host-side n-gram counting feeds fixed-shape ``(n_gram,)`` device states, so
+the distributed sync stays a plain ``psum`` over four small tensors.
+"""
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _bleu_normalize_inputs(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int,
+    weights: Optional[Sequence[float]],
+) -> Tuple[Sequence[str], Sequence[Sequence[str]], Sequence[float]]:
+    """Shared normalization/validation for every BLEU entry point."""
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    return preds_, target_, list(weights) if weights is not None else [1.0 / n_gram] * n_gram
+
+
+def _count_ngram(tokens: Sequence[str], n_gram: int) -> Counter:
+    counter: Counter = Counter()
+    for n in range(1, n_gram + 1):
+        for j in range(len(tokens) - n + 1):
+            counter[tuple(tokens[j : j + n])] += 1
+    return counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Count clipped n-gram matches for the batch.
+
+    Returns (numerator, denominator) of shape ``(n_gram,)`` plus the candidate
+    length and the closest-reference length totals.
+    """
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len = 0
+    target_len = 0
+    tgt_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    prd_tok = [tokenizer(line) if line else [] for line in preds]
+    for pred, targets in zip(prd_tok, tgt_tok):
+        preds_len += len(pred)
+        tgt_lens = [len(t) for t in targets]
+        # closest reference length; ties broken by the shorter reference
+        # (mteval/sacrebleu/NLTK convention)
+        target_len += min(tgt_lens, key=lambda x: (abs(len(pred) - x), x))
+        pred_counter = _count_ngram(pred, n_gram)
+        tgt_counter: Counter = Counter()
+        for t in targets:
+            tgt_counter |= _count_ngram(t, n_gram)
+        clipped = pred_counter & tgt_counter
+        for key, cnt in clipped.items():
+            numerator[len(key) - 1] += cnt
+        for key, cnt in pred_counter.items():
+            denominator[len(key) - 1] += cnt
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Geometric mean of n-gram precisions with brevity penalty (jit-safe)."""
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+    log_precision = jnp.asarray(list(weights)) * jnp.log(precision)
+    geometric_mean = jnp.exp(jnp.sum(log_precision))
+    brevity = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    bleu = brevity * geometric_mean
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, bleu)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU score of translated text against one or more references.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(bleu_score(preds, target)), 4)
+        0.7598
+    """
+    preds_, target_, weights = _bleu_normalize_inputs(preds, target, n_gram, weights)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram)
+    return _bleu_score_compute(
+        jnp.asarray(preds_len, jnp.float32),
+        jnp.asarray(target_len, jnp.float32),
+        jnp.asarray(numerator, jnp.float32),
+        jnp.asarray(denominator, jnp.float32),
+        n_gram,
+        weights,
+        smooth,
+    )

@@ -1,0 +1,40 @@
+"""Character error rate (reference ``functional/text/cer.py:23-78``)."""
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch, _normalize_str_list
+
+Array = jax.Array
+
+
+def _cer_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array]:
+    """Sum of character-level edit distances and total reference characters."""
+    preds = _normalize_str_list(preds)
+    target = _normalize_str_list(target)
+    pred_chars = [list(p) for p in preds]
+    tgt_chars = [list(t) for t in target]
+    errors = int(_edit_distance_batch(pred_chars, tgt_chars).sum())
+    total = sum(len(t) for t in tgt_chars)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate over reference characters.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(char_error_rate(preds=preds, target=target)), 4)
+        0.3415
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

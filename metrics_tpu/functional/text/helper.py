@@ -1,0 +1,49 @@
+"""Shared text-metric helpers.
+
+Parity target: reference ``functional/text/helper.py`` (edit distance at
+333-354, corpus normalization at 299-331).  The edit-distance hot loop runs in
+the first-party C++ kernel (``metrics_tpu/_native``) instead of pure Python.
+"""
+
+from typing import List, Sequence, Tuple, Union
+
+from metrics_tpu._native import edit_distance as _native_edit_distance
+from metrics_tpu._native import edit_distance_batch as _native_edit_distance_batch
+
+
+def _edit_distance(prediction_tokens: Sequence[str], reference_tokens: Sequence[str]) -> int:
+    """Levenshtein distance between token sequences (words or characters)."""
+    return _native_edit_distance(prediction_tokens, reference_tokens)
+
+
+def _edit_distance_batch(
+    predictions: Sequence[Sequence[str]], references: Sequence[Sequence[str]]
+):
+    """Vectorized per-pair edit distances (one native call for the batch)."""
+    return _native_edit_distance_batch(predictions, references)
+
+
+def _validate_inputs(
+    target_corpus: Union[Sequence[str], Sequence[Sequence[str]]],
+    preds_corpus: Union[str, Sequence[str]],
+) -> Tuple[Sequence[Sequence[str]], Sequence[str]]:
+    """Normalize (target, preds) into (List[List[str]], List[str]).
+
+    Mirrors reference ``functional/text/helper.py:299-331``: a lone hypothesis
+    string is wrapped; a flat list of reference strings becomes one
+    reference-set per hypothesis (or the reference set of a single hypothesis).
+    """
+    if isinstance(preds_corpus, str):
+        preds_corpus = [preds_corpus]
+    if all(isinstance(ref, str) for ref in target_corpus):
+        if len(preds_corpus) == 1:
+            target_corpus = [target_corpus]  # type: ignore[list-item]
+        else:
+            target_corpus = [[ref] for ref in target_corpus]  # type: ignore[misc]
+    if preds_corpus and all(ref for ref in target_corpus) and len(target_corpus) != len(preds_corpus):
+        raise ValueError(f"Corpus has different size {len(target_corpus)} != {len(preds_corpus)}")
+    return target_corpus, preds_corpus
+
+
+def _normalize_str_list(x: Union[str, Sequence[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)

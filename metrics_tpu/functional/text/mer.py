@@ -1,0 +1,40 @@
+"""Match error rate (reference ``functional/text/mer.py:23-90``)."""
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch, _normalize_str_list
+
+Array = jax.Array
+
+
+def _mer_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array]:
+    """Sum of edit distances and sum of max(len(ref), len(pred)) per pair."""
+    preds = _normalize_str_list(preds)
+    target = _normalize_str_list(target)
+    pred_tok = [p.split() for p in preds]
+    tgt_tok = [t.split() for t in target]
+    errors = int(_edit_distance_batch(pred_tok, tgt_tok).sum())
+    total = sum(max(len(t), len(p)) for t, p in zip(tgt_tok, pred_tok))
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate: errors over matches-plus-errors.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(match_error_rate(preds=preds, target=target)), 4)
+        0.4444
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

@@ -1,0 +1,40 @@
+"""Word error rate (reference ``functional/text/wer.py:23-83``)."""
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch, _normalize_str_list
+
+Array = jax.Array
+
+
+def _wer_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array]:
+    """Sum of edit distances and total reference words over the batch."""
+    preds = _normalize_str_list(preds)
+    target = _normalize_str_list(target)
+    pred_tok = [p.split() for p in preds]
+    tgt_tok = [t.split() for t in target]
+    errors = int(_edit_distance_batch(pred_tok, tgt_tok).sum())
+    total = sum(len(t) for t in tgt_tok)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word error rate: fraction of reference words wrongly transcribed.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(word_error_rate(preds=preds, target=target))
+        0.5
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

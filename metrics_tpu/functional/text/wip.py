@@ -1,0 +1,49 @@
+"""Word information preserved (reference ``functional/text/wip.py:21-90``)."""
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance_batch, _normalize_str_list
+
+Array = jax.Array
+
+
+def _wip_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Return (distance - max_len, total ref words, total pred words)."""
+    preds = _normalize_str_list(preds)
+    target = _normalize_str_list(target)
+    pred_tok = [p.split() for p in preds]
+    tgt_tok = [t.split() for t in target]
+    dists = _edit_distance_batch(pred_tok, tgt_tok)
+    errors = int(dists.sum())
+    total = sum(max(len(t), len(p)) for t, p in zip(tgt_tok, pred_tok))
+    target_total = sum(len(t) for t in tgt_tok)
+    preds_total = sum(len(p) for p in pred_tok)
+    return (
+        jnp.asarray(errors - total, jnp.float32),
+        jnp.asarray(target_total, jnp.float32),
+        jnp.asarray(preds_total, jnp.float32),
+    )
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Array:
+    """Word information preserved: ``(H/N_ref) * (H/N_pred)``.
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_preserved(preds, target)), 4)
+        0.3472
+    """
+    errors, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)

@@ -1,0 +1,124 @@
+"""BERTScore module metric (reference ``text/bert.py:41-225``).
+
+State design (reference ``text/bert.py:170-203``): update tokenizes strings to
+**fixed-width int tensors** so the distributed sync is a tensor all-gather,
+never a string exchange.  compute() embeds the gathered corpus and runs the
+vmapped greedy-matching kernel.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.bert import (
+    _apply_idf,
+    _default_tokenize,
+    _idf_weights,
+    _load_flax_model,
+    _model_forward,
+    _run_matching,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jit_update_default = False
+    jit_compute_default = False
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 128,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_values: Optional[Dict[str, float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if model is None:
+            if model_name_or_path is None:
+                raise ValueError(
+                    "Either `model_name_or_path` or a `model` + `user_tokenizer` must be provided."
+                )
+            user_tokenizer, model = _load_flax_model(model_name_or_path)
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` is required when passing an own model.")
+        self.model = model
+        self.tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.model_name_or_path = model_name_or_path
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_values = baseline_values
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        preds_l = [preds] if isinstance(preds, str) else list(preds)
+        target_l = [target] if isinstance(target, str) else list(target)
+        if len(preds_l) != len(target_l):
+            raise ValueError("Number of predicted and reference sentences must match.")
+        p_tok = _default_tokenize(preds_l, self.tokenizer, self.max_length)
+        t_tok = _default_tokenize(target_l, self.tokenizer, self.max_length)
+        self.preds_input_ids.append(jnp.asarray(p_tok["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(p_tok["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(t_tok["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(t_tok["attention_mask"]))
+
+    def compute(self) -> Dict[str, List[float]]:
+        p_ids = np.asarray(jnp.concatenate(self.preds_input_ids, axis=0))
+        p_mask = np.asarray(jnp.concatenate(self.preds_attention_mask, axis=0))
+        t_ids = np.asarray(jnp.concatenate(self.target_input_ids, axis=0))
+        t_mask = np.asarray(jnp.concatenate(self.target_attention_mask, axis=0))
+
+        if self.user_forward_fn is not None:
+            p_emb = self.user_forward_fn(self.model, p_ids, p_mask)
+            t_emb = self.user_forward_fn(self.model, t_ids, t_mask)
+        else:
+            p_emb = _model_forward(self.model, p_ids, p_mask, self.num_layers, self.all_layers, self.batch_size)
+            t_emb = _model_forward(self.model, t_ids, t_mask, self.num_layers, self.all_layers, self.batch_size)
+
+        if self.idf:
+            weights = _idf_weights(t_ids, t_mask, t_ids.shape[0])
+            pw = _apply_idf(p_ids, p_mask, weights)
+            tw = _apply_idf(t_ids, t_mask, weights)
+        else:
+            pw = np.ones(p_ids.shape, dtype=np.float32)
+            tw = np.ones(t_ids.shape, dtype=np.float32)
+
+        out = _run_matching(
+            jnp.asarray(p_emb), jnp.asarray(p_mask, jnp.float32),
+            jnp.asarray(t_emb), jnp.asarray(t_mask, jnp.float32),
+            jnp.asarray(pw), jnp.asarray(tw),
+        )
+        if self.rescale_with_baseline:
+            if self.baseline_values is None:
+                raise ValueError("`rescale_with_baseline` needs `baseline_values` in offline builds.")
+            out = {k: (v - self.baseline_values[k]) / (1.0 - self.baseline_values[k]) for k, v in out.items()}
+        result = {k: np.asarray(v).tolist() for k, v in out.items()}
+        if self.return_hash:
+            result["hash"] = f"metrics_tpu-bert_score-{self.model_name_or_path or 'user-model'}"
+        return result

@@ -1,0 +1,60 @@
+"""BLEUScore module metric (reference ``text/bleu.py:28-120``)."""
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import (
+    _bleu_normalize_inputs,
+    _bleu_score_compute,
+    _bleu_score_update,
+    _tokenize_fn,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    """Streaming corpus BLEU with fixed-shape ``(n_gram,)`` count states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    jit_update_default = False
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        _, _, self.weights = _bleu_normalize_inputs([], [], n_gram, weights)
+        self.add_state("preds_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds_, target_, _ = _bleu_normalize_inputs(preds, target, self.n_gram, None)
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, self.n_gram, self._tokenizer()
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = self.numerator + jnp.asarray(numerator, jnp.float32)
+        self.denominator = self.denominator + jnp.asarray(denominator, jnp.float32)
+
+    def _tokenizer(self):
+        return _tokenize_fn
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator,
+            self.n_gram, self.weights, self.smooth,
+        )

@@ -1,0 +1,31 @@
+"""CharErrorRate module metric (reference ``text/cer.py:24-98``)."""
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.cer import _cer_compute, _cer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CharErrorRate(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    jit_update_default = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _cer_compute(self.errors, self.total)

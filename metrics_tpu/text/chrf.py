@@ -1,0 +1,85 @@
+"""CHRFScore module metric (reference ``text/chrf.py:46-188``)."""
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """Streaming corpus chrF/chrF++ with per-order array states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    jit_update_default = False
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+        self.add_state("preds_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("preds_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("target_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("target_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("matching_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("matching_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        scores = [] if self.return_sentence_level_score else None
+        p_char, p_word, t_char, t_word, m_char, m_word = _chrf_score_update(
+            preds_, target_, self.n_char_order, self.n_word_order,
+            self.beta, self.lowercase, self.whitespace, scores,
+        )
+        self.preds_char = self.preds_char + jnp.asarray(p_char, jnp.float32)
+        self.preds_word = self.preds_word + jnp.asarray(p_word, jnp.float32)
+        self.target_char = self.target_char + jnp.asarray(t_char, jnp.float32)
+        self.target_word = self.target_word + jnp.asarray(t_word, jnp.float32)
+        self.matching_char = self.matching_char + jnp.asarray(m_char, jnp.float32)
+        self.matching_word = self.matching_word + jnp.asarray(m_word, jnp.float32)
+        if self.return_sentence_level_score:
+            self.sentence_chrf_score.append(jnp.asarray(scores, jnp.float32))
+
+    def compute(self) -> Union[Array, tuple]:
+        score = _chrf_score_compute(
+            self.preds_char, self.preds_word,
+            self.target_char, self.target_word,
+            self.matching_char, self.matching_word,
+            self.n_order, self.beta,
+        )
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_chrf_score])
+        return score

@@ -1,0 +1,60 @@
+"""ExtendedEditDistance module metric (reference ``text/eed.py:24-102``)."""
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    jit_update_default = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+        self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("score_count", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> None:
+        scores = [] if self.return_sentence_level_score else None
+        total, count = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, scores
+        )
+        self.score_sum = self.score_sum + total
+        self.score_count = self.score_count + count
+        if self.return_sentence_level_score:
+            self.sentence_eed.append(jnp.asarray(scores, jnp.float32))
+
+    def compute(self) -> Union[Array, tuple]:
+        score = _eed_compute(self.score_sum, self.score_count)
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_eed])
+        return score

@@ -1,0 +1,90 @@
+"""ROUGEScore module metric (reference ``text/rouge.py:31-154``)."""
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _make_stemmer,
+    _rouge_score_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """Streaming ROUGE with per-(key, stat) sum states and a shared count.
+
+    The reference appends per-sentence scores to list states; averaging on the
+    fly keeps every state a sum-reducible scalar.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jit_update_default = False
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(rouge_keys, str):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS)}")
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[k] for k in rouge_keys]
+        self.stemmer = _make_stemmer() if use_stemmer else None
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+        for key in self.rouge_keys_values:
+            for stat in ("fmeasure", "precision", "recall"):
+                self.add_state(f"rouge{key}_{stat}_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(t, str) for t in target):
+            target = [target] if isinstance(preds, str) else [[t] for t in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        stats = _rouge_score_update(
+            preds, target, self.rouge_keys_values, self.accumulate,
+            self.stemmer, self.normalizer, self.tokenizer,
+        )
+        n = 0
+        for key, per_stat in stats.items():
+            for stat, (total, count) in per_stat.items():
+                name = f"rouge{key}_{stat}_sum"
+                self._state[name] = self._state[name] + total
+                n = count
+        self.total = self.total + n
+
+    def compute(self) -> Dict[str, Array]:
+        denom = jnp.maximum(self.total, 1.0)
+        out = {}
+        for key in self.rouge_keys_values:
+            for stat in ("fmeasure", "precision", "recall"):
+                out[f"rouge{key}_{stat}"] = self._state[f"rouge{key}_{stat}_sum"] / denom
+        return out

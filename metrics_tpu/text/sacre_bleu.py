@@ -1,0 +1,25 @@
+"""SacreBLEUScore module metric (reference ``text/sacre_bleu.py:32-117``)."""
+
+from typing import Any, Optional, Sequence
+
+from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from metrics_tpu.text.bleu import BLEUScore
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU over a standard WMT tokenizer (subclasses the BLEU count engine)."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    def _tokenizer(self):
+        return self.tokenizer

@@ -1,0 +1,51 @@
+"""TranslationEditRate module metric (reference ``text/ter.py:24-109``)."""
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    jit_update_default = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> None:
+        scores = [] if self.return_sentence_level_score else None
+        num_edits, tgt_length = _ter_update(preds, target, self.tokenizer, scores)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_length = self.total_tgt_length + tgt_length
+        if self.return_sentence_level_score:
+            self.sentence_ter.append(jnp.asarray(scores, jnp.float32))
+
+    def compute(self) -> Union[Array, tuple]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_length)
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_ter])
+        return score

@@ -1,0 +1,90 @@
+"""Text-metric tester (reference ``tests/unittests/text/helpers.py`` pattern).
+
+String inputs cannot ride shard_map, so the distributed assertion here is the
+host-level one the text metrics actually use: two metric instances each see
+half the batches, their states are merged via ``merge_state`` (the DCN path),
+and the result must equal the single-instance run over all data — the same
+"sharded == concatenated oracle" contract as the tensor metrics.
+"""
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _flatten(batches: Sequence[Any]) -> List[Any]:
+    out: List[Any] = []
+    for b in batches:
+        out.extend(b)
+    return out
+
+
+def _assert_close(a: Any, b: Any, atol: float) -> None:
+    if isinstance(a, dict):
+        for k in b:
+            _assert_close(a[k], b[k], atol)
+        return
+    if isinstance(a, tuple):
+        _assert_close(a[0], b[0], atol)
+        return
+    np.testing.assert_allclose(np.asarray(a, np.float64), np.asarray(b, np.float64), atol=atol, rtol=1e-4)
+
+
+class TextTester:
+    atol: float = 1e-5
+
+    def run_text_class_test(
+        self,
+        preds_batches: Sequence[Sequence[str]],
+        target_batches: Sequence[Any],
+        metric_class: type,
+        reference_fn: Callable[[List[str], List[Any]], Any],
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        atol = atol if atol is not None else self.atol
+
+        metric = metric_class(**metric_args)
+        metric = pickle.loads(pickle.dumps(metric))  # pickle round-trip
+        for p, t in zip(preds_batches, target_batches):
+            metric.update(p, t)
+        total = metric.compute()
+        ref_total = reference_fn(_flatten(preds_batches), _flatten(target_batches))
+        _assert_close(total, ref_total, atol)
+
+        # reset clears state
+        metric.reset()
+        metric.update(preds_batches[0], target_batches[0])
+        _assert_close(
+            metric.compute(),
+            reference_fn(list(preds_batches[0]), list(target_batches[0])),
+            atol,
+        )
+
+        # simulated 2-rank run: half the batches per instance, merged states
+        n = len(preds_batches)
+        m0 = metric_class(**metric_args)
+        m1 = metric_class(**metric_args)
+        for i in range(n):
+            (m0 if i % 2 == 0 else m1).update(preds_batches[i], target_batches[i])
+        m0.merge_state(m1._state)
+        m0._update_count += m1._update_count
+        _assert_close(m0.compute(), ref_total, atol)
+
+    def run_text_functional_test(
+        self,
+        preds_batches: Sequence[Sequence[str]],
+        target_batches: Sequence[Any],
+        metric_functional: Callable,
+        reference_fn: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        atol = atol if atol is not None else self.atol
+        for p, t in zip(preds_batches, target_batches):
+            got = metric_functional(p, t, **metric_args)
+            want = reference_fn(list(p), list(t))
+            _assert_close(got, want, atol)

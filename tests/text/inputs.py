@@ -1,0 +1,50 @@
+"""Deterministic text fixtures (mirrors the reference's input-bank pattern,
+``tests/unittests/text/inputs.py``)."""
+
+# 4 batches x 4 sentence pairs for error-rate metrics
+ER_PREDS = [
+    ["this is the prediction", "there is an other sample",
+     "the cat sat on mat", "hello duck"],
+    ["a quick brown fox", "jumps over a lazy dog",
+     "i like pizza", "you like pasta more"],
+    ["speech recognition is fun", "metrics are hard to get right",
+     "one two three four", "five six seven"],
+    ["an apple a day", "keeps doctors away",
+     "empty", "almost the same sentence here"],
+]
+ER_TARGET = [
+    ["this is the reference", "there is another one",
+     "the cat sat on the mat", "hello world duck"],
+    ["the quick brown fox", "jumped over the lazy dog",
+     "i like pizza a lot", "you like pasta"],
+    ["speech recognition is great fun", "metrics are hard to define right",
+     "one two three five", "five six seven eight"],
+    ["an apple a day", "keeps the doctor away",
+     "nonempty", "almost the same sentence there"],
+]
+
+# translation-style fixtures: per-hypothesis multiple references
+MT_PREDS = [
+    ["the cat is on the mat", "hello there general kenobi"],
+    ["master kenobi you are a bold one", "my name is john"],
+]
+MT_TARGET = [
+    [["there is a cat on the mat", "a cat is on the mat"],
+     ["hello there general kenobi", "hello there!"]],
+    [["general kenobi you are such a bold one", "you are a bold one master"],
+     ["my name is john", "john is my name"]],
+]
+
+# summarization-style single-reference fixtures for ROUGE
+SUM_PREDS = [
+    ["The quick brown fox jumps over the lazy dog",
+     "My name is John and I like apples"],
+    ["Metrics frameworks compute many scores",
+     "A fast brown fox leaped over dogs"],
+]
+SUM_TARGET = [
+    ["The fast brown fox jumps over the lazy dog",
+     "Is your name John or James"],
+    ["Frameworks for metrics compute scores",
+     "The quick brown fox jumps over the dog"],
+]

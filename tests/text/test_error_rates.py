@@ -1,0 +1,92 @@
+"""WER / CER / MER / WIL / WIP vs an independent DP reference."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.text import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.text import (
+    CharErrorRate,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from tests.text.helpers import TextTester
+from tests.text.inputs import ER_PREDS, ER_TARGET
+
+
+def _ref_edit_distance(a, b):
+    """Independent full-matrix DP (different structure from the library's
+    two-row native kernel)."""
+    dp = np.zeros((len(a) + 1, len(b) + 1), dtype=np.int64)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(
+                dp[i - 1, j] + 1,
+                dp[i, j - 1] + 1,
+                dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]),
+            )
+    return int(dp[-1, -1])
+
+
+def _ref_wer(preds, target):
+    errs = sum(_ref_edit_distance(p.split(), t.split()) for p, t in zip(preds, target))
+    total = sum(len(t.split()) for t in target)
+    return errs / total
+
+
+def _ref_cer(preds, target):
+    errs = sum(_ref_edit_distance(list(p), list(t)) for p, t in zip(preds, target))
+    total = sum(len(t) for t in target)
+    return errs / total
+
+
+def _ref_mer(preds, target):
+    errs = sum(_ref_edit_distance(p.split(), t.split()) for p, t in zip(preds, target))
+    total = sum(max(len(t.split()), len(p.split())) for p, t in zip(preds, target))
+    return errs / total
+
+
+def _ref_hits(preds, target):
+    hits = 0.0
+    for p, t in zip(preds, target):
+        pt, tt = p.split(), t.split()
+        hits += max(len(pt), len(tt)) - _ref_edit_distance(pt, tt)
+    return hits
+
+
+def _ref_wip(preds, target):
+    h = _ref_hits(preds, target)
+    n_t = sum(len(t.split()) for t in target)
+    n_p = sum(len(p.split()) for p in preds)
+    return (h / n_t) * (h / n_p)
+
+
+def _ref_wil(preds, target):
+    return 1 - _ref_wip(preds, target)
+
+
+CASES = [
+    (WordErrorRate, word_error_rate, _ref_wer),
+    (CharErrorRate, char_error_rate, _ref_cer),
+    (MatchErrorRate, match_error_rate, _ref_mer),
+    (WordInfoLost, word_information_lost, _ref_wil),
+    (WordInfoPreserved, word_information_preserved, _ref_wip),
+]
+
+
+@pytest.mark.parametrize("metric_class, functional, ref", CASES)
+class TestErrorRates(TextTester):
+    def test_class(self, metric_class, functional, ref):
+        self.run_text_class_test(ER_PREDS, ER_TARGET, metric_class, ref)
+
+    def test_functional(self, metric_class, functional, ref):
+        self.run_text_functional_test(ER_PREDS, ER_TARGET, functional, ref)

@@ -1,0 +1,139 @@
+"""BLEU / SacreBLEU / CHRF / TER vs the sacrebleu oracle; EED vs published
+reference values."""
+
+import numpy as np
+import pytest
+from sacrebleu.metrics import BLEU as SB_BLEU, CHRF as SB_CHRF, TER as SB_TER
+
+from metrics_tpu.functional.text import (
+    bleu_score,
+    chrf_score,
+    extended_edit_distance,
+    sacre_bleu_score,
+    translation_edit_rate,
+)
+from metrics_tpu.text import (
+    BLEUScore,
+    CHRFScore,
+    ExtendedEditDistance,
+    SacreBLEUScore,
+    TranslationEditRate,
+)
+from tests.text.helpers import TextTester
+from tests.text.inputs import MT_PREDS, MT_TARGET
+
+
+def _to_streams(target):
+    """(per-hyp refs) -> sacrebleu's transposed ref streams."""
+    maxr = max(len(t) for t in target)
+    return [[t[i] if i < len(t) else t[-1] for t in target] for i in range(maxr)]
+
+
+def _ref_sacre_bleu(preds, target):
+    return SB_BLEU(tokenize="13a").corpus_score(preds, _to_streams(target)).score / 100
+
+
+def _ref_bleu_none(preds, target):
+    # whitespace tokenization == sacrebleu tokenize='none'
+    return SB_BLEU(tokenize="none").corpus_score(preds, _to_streams(target)).score / 100
+
+
+# torchmetrics-style chrF averages per-order F-scores (chrF++.py convention),
+# which is sacrebleu's `eps_smoothing=True` mode
+def _ref_chrf(preds, target):
+    return SB_CHRF(word_order=2, eps_smoothing=True).corpus_score(preds, _to_streams(target)).score / 100
+
+
+def _ref_chrf_no_word(preds, target):
+    return SB_CHRF(word_order=0, eps_smoothing=True).corpus_score(preds, _to_streams(target)).score / 100
+
+
+def _ref_ter(preds, target):
+    return SB_TER().corpus_score(preds, _to_streams(target)).score / 100
+
+
+class TestBLEU(TextTester):
+    atol = 1e-4
+
+    def test_class(self):
+        self.run_text_class_test(MT_PREDS, MT_TARGET, BLEUScore, _ref_bleu_none)
+
+    def test_functional(self):
+        self.run_text_functional_test(MT_PREDS, MT_TARGET, bleu_score, _ref_bleu_none)
+
+    def test_weights_and_smooth(self):
+        out = bleu_score(MT_PREDS[0], MT_TARGET[0], n_gram=2, smooth=True, weights=[0.7, 0.3])
+        assert 0.0 <= float(out) <= 1.0
+        with pytest.raises(ValueError):
+            bleu_score(MT_PREDS[0], MT_TARGET[0], n_gram=4, weights=[0.5, 0.5])
+
+
+class TestSacreBLEU(TextTester):
+    atol = 1e-4
+
+    def test_class(self):
+        self.run_text_class_test(MT_PREDS, MT_TARGET, SacreBLEUScore, _ref_sacre_bleu)
+
+    def test_functional(self):
+        self.run_text_functional_test(MT_PREDS, MT_TARGET, sacre_bleu_score, _ref_sacre_bleu)
+
+    @pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl"])
+    def test_tokenizers(self, tokenize):
+        got = float(sacre_bleu_score(MT_PREDS[0], MT_TARGET[0], tokenize=tokenize))
+        want = SB_BLEU(tokenize=tokenize).corpus_score(MT_PREDS[0], _to_streams(MT_TARGET[0])).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestCHRF(TextTester):
+    atol = 1e-4
+
+    def test_class(self):
+        self.run_text_class_test(MT_PREDS, MT_TARGET, CHRFScore, _ref_chrf)
+
+    def test_functional(self):
+        self.run_text_functional_test(MT_PREDS, MT_TARGET, chrf_score, _ref_chrf)
+
+    def test_chrf_without_word_order(self):
+        got = float(chrf_score(MT_PREDS[0], MT_TARGET[0], n_word_order=0))
+        np.testing.assert_allclose(got, _ref_chrf_no_word(MT_PREDS[0], MT_TARGET[0]), atol=1e-4)
+
+    def test_sentence_level(self):
+        corpus, sentences = chrf_score(MT_PREDS[0], MT_TARGET[0], return_sentence_level_score=True)
+        assert sentences.shape == (len(MT_PREDS[0]),)
+
+
+class TestTER(TextTester):
+    atol = 1e-4
+
+    def test_class(self):
+        self.run_text_class_test(MT_PREDS, MT_TARGET, TranslationEditRate, _ref_ter)
+
+    def test_functional(self):
+        self.run_text_functional_test(MT_PREDS, MT_TARGET, translation_edit_rate, _ref_ter)
+
+    def test_shift_case(self):
+        # a pure phrase shift costs 1 edit, not many
+        got = float(translation_edit_rate(["b c d e a"], [["a b c d e"]]))
+        np.testing.assert_allclose(got, 1 / 5, atol=1e-6)
+
+
+class TestEED(TextTester):
+    atol = 1e-4
+
+    def test_reference_value(self):
+        # value documented in the upstream docstring (functional/text/eed.py:387-388)
+        preds = ["this is the prediction", "here is an other sample"]
+        target = ["this is the reference", "here is another one"]
+        np.testing.assert_allclose(float(extended_edit_distance(preds, target)), 0.3078, atol=1e-4)
+
+    def test_class_streaming_matches_functional(self):
+        def ref(preds, target):
+            return float(extended_edit_distance(preds, target))
+
+        self.run_text_class_test(MT_PREDS, MT_TARGET, ExtendedEditDistance, ref)
+
+    def test_sentence_level(self):
+        score, per_sent = extended_edit_distance(
+            ["a b", "c d"], ["a b", "c e"], return_sentence_level_score=True
+        )
+        assert per_sent.shape == (2,)

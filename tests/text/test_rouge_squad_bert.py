@@ -1,0 +1,217 @@
+"""ROUGE vs rouge_score oracle, SQuAD vs hand oracle, BERTScore vs a numpy
+greedy-matching reference on a deterministic toy encoder."""
+
+import numpy as np
+import pytest
+from rouge_score.rouge_scorer import RougeScorer
+
+from metrics_tpu.functional.text import bert_score, rouge_score, squad
+from metrics_tpu.text import BERTScore, ROUGEScore, SQuAD
+from tests.text.helpers import TextTester
+from tests.text.inputs import SUM_PREDS, SUM_TARGET
+
+ROUGE_KEYS = ("rouge1", "rouge2", "rougeL")
+
+
+def _ref_rouge(preds, target, use_stemmer=False):
+    scorer = RougeScorer(list(ROUGE_KEYS), use_stemmer=use_stemmer)
+    sums = {f"{k}_{s}": 0.0 for k in ROUGE_KEYS for s in ("precision", "recall", "fmeasure")}
+    for p, t in zip(preds, target):
+        res = scorer.score(t, p)
+        for k in ROUGE_KEYS:
+            sums[f"{k}_precision"] += res[k].precision
+            sums[f"{k}_recall"] += res[k].recall
+            sums[f"{k}_fmeasure"] += res[k].fmeasure
+    return {name: v / len(preds) for name, v in sums.items()}
+
+
+class TestROUGE(TextTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("use_stemmer", [False, True])
+    def test_class(self, use_stemmer):
+        def ref(preds, target):
+            return _ref_rouge(preds, target, use_stemmer)
+
+        self.run_text_class_test(
+            SUM_PREDS, SUM_TARGET, ROUGEScore,
+            ref, metric_args={"rouge_keys": ROUGE_KEYS, "use_stemmer": use_stemmer},
+        )
+
+    def test_functional(self):
+        self.run_text_functional_test(
+            SUM_PREDS, SUM_TARGET, rouge_score, _ref_rouge,
+            metric_args={"rouge_keys": ROUGE_KEYS},
+        )
+
+    def test_multi_reference_best(self):
+        out = rouge_score(
+            ["the cat is here"], [["a cat is here", "the cat is here today"]],
+            rouge_keys="rouge1", accumulate="best",
+        )
+        assert float(out["rouge1_fmeasure"]) > 0.8
+
+    def test_lsum_single_sentences(self):
+        scorer = RougeScorer(["rougeLsum"])
+        p, t = "the quick brown fox", "a quick brown dog"
+        got = rouge_score(p, t, rouge_keys="rougeLsum")
+        want = scorer.score(t, p)["rougeLsum"]
+        np.testing.assert_allclose(float(got["rougeLsum_fmeasure"]), want.fmeasure, atol=1e-6)
+
+    def test_unknown_key_raises(self):
+        with pytest.raises(ValueError):
+            rouge_score("a", "a", rouge_keys="rouge42")
+
+
+def _ref_squad(preds, target):
+    import re
+    import string
+    from collections import Counter
+
+    def norm(s):
+        s = s.lower()
+        s = "".join(ch for ch in s if ch not in set(string.punctuation))
+        s = re.sub(r"\b(a|an|the)\b", " ", s)
+        return " ".join(s.split())
+
+    em_sum = f1_sum = 0.0
+    for p, t in zip(preds, target):
+        answers = t["answers"]["text"]
+        em_sum += max(float(norm(p["prediction_text"]) == norm(a)) for a in answers)
+        best_f1 = 0.0
+        for a in answers:
+            pt, tt = norm(p["prediction_text"]).split(), norm(a).split()
+            common = sum((Counter(pt) & Counter(tt)).values())
+            if not pt or not tt:
+                best_f1 = max(best_f1, float(pt == tt))
+            elif common:
+                pr, rc = common / len(pt), common / len(tt)
+                best_f1 = max(best_f1, 2 * pr * rc / (pr + rc))
+        f1_sum += best_f1
+    n = len(preds)
+    return {"exact_match": 100 * em_sum / n, "f1": 100 * f1_sum / n}
+
+
+SQUAD_PREDS = [
+    [{"prediction_text": "1976", "id": "q1"},
+     {"prediction_text": "the big apple", "id": "q2"}],
+    [{"prediction_text": "albert einstein", "id": "q3"},
+     {"prediction_text": "completely wrong", "id": "q4"}],
+]
+SQUAD_TARGET = [
+    [{"answers": {"answer_start": [0], "text": ["1976"]}, "id": "q1"},
+     {"answers": {"answer_start": [0], "text": ["big apple", "new york"]}, "id": "q2"}],
+    [{"answers": {"answer_start": [0], "text": ["einstein", "albert einstein"]}, "id": "q3"},
+     {"answers": {"answer_start": [0], "text": ["right answer"]}, "id": "q4"}],
+]
+
+
+class TestSQuAD(TextTester):
+    def test_class(self):
+        self.run_text_class_test(SQUAD_PREDS, SQUAD_TARGET, SQuAD, _ref_squad)
+
+    def test_functional(self):
+        for p, t in zip(SQUAD_PREDS, SQUAD_TARGET):
+            got = squad(p, t)
+            want = _ref_squad(p, t)
+            np.testing.assert_allclose(float(got["f1"]), want["f1"], atol=1e-4)
+            np.testing.assert_allclose(float(got["exact_match"]), want["exact_match"], atol=1e-4)
+
+    def test_bad_keys_raise(self):
+        with pytest.raises(KeyError):
+            squad([{"wrong": "x", "id": "1"}], SQUAD_TARGET[0])
+
+
+class _ToyTokenizer:
+    """Deterministic hash tokenizer (no external data)."""
+
+    def __call__(self, texts, padding=None, max_length=16, truncation=True, return_attention_mask=True):
+        ids, masks = [], []
+        for t in texts:
+            toks = [(hash(w) % 977) + 1 for w in t.split()][:max_length]
+            mask = [1] * len(toks)
+            pad = max_length - len(toks)
+            ids.append(toks + [0] * pad)
+            masks.append(mask + [0] * pad)
+        return {"input_ids": ids, "attention_mask": masks}
+
+
+class _ToyModel:
+    """Embedding = fixed random table lookup; mimics last_hidden_state."""
+
+    def __init__(self, dim=8):
+        rng = np.random.default_rng(42)
+        self.table = rng.normal(size=(978, dim)).astype(np.float32)
+
+    def embed(self, ids):
+        return self.table[np.asarray(ids)]
+
+
+def _toy_forward(model, input_ids, attention_mask):
+    return model.embed(input_ids)
+
+
+def _ref_bert_score(preds, target, tokenizer, model):
+    p_tok = tokenizer(list(preds), max_length=16)
+    t_tok = tokenizer(list(target), max_length=16)
+    out = {"precision": [], "recall": [], "f1": []}
+    for pi, pm, ti, tm in zip(
+        p_tok["input_ids"], p_tok["attention_mask"], t_tok["input_ids"], t_tok["attention_mask"]
+    ):
+        pe = model.embed([i for i, m in zip(pi, pm) if m])
+        te = model.embed([i for i, m in zip(ti, tm) if m])
+        pe = pe / np.linalg.norm(pe, axis=-1, keepdims=True)
+        te = te / np.linalg.norm(te, axis=-1, keepdims=True)
+        sim = pe @ te.T
+        precision = sim.max(axis=1).mean()
+        recall = sim.max(axis=0).mean()
+        f1 = 2 * precision * recall / (precision + recall)
+        out["precision"].append(precision)
+        out["recall"].append(recall)
+        out["f1"].append(f1)
+    return out
+
+
+class TestBERTScore(TextTester):
+    atol = 1e-4
+
+    def _args(self):
+        model = _ToyModel()
+        return dict(
+            model=model,
+            user_tokenizer=_ToyTokenizer(),
+            user_forward_fn=_toy_forward,
+            max_length=16,
+        )
+
+    def test_functional(self):
+        preds = ["hello there", "general kenobi is here"]
+        target = ["hello here", "general kenobi was there"]
+        args = self._args()
+        got = bert_score(preds, target, **args)
+        want = _ref_bert_score(preds, target, args["user_tokenizer"], args["model"])
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(got[k], want[k], atol=1e-4)
+
+    def test_class_streaming(self):
+        args = self._args()
+        metric = BERTScore(**args)
+        batches_p = [["hello there"], ["general kenobi is here", "metrics are fun"]]
+        batches_t = [["hello here"], ["general kenobi was there", "metrics are great fun"]]
+        for p, t in zip(batches_p, batches_t):
+            metric.update(p, t)
+        got = metric.compute()
+        flat_p = [s for b in batches_p for s in b]
+        flat_t = [s for b in batches_t for s in b]
+        want = _ref_bert_score(flat_p, flat_t, args["user_tokenizer"], args["model"])
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(got[k], want[k], atol=1e-4)
+
+    def test_idf_path_runs(self):
+        args = self._args()
+        out = bert_score(["a b c"], ["a b d"], idf=True, **args)
+        assert 0.0 <= out["f1"][0] <= 1.0
+
+    def test_missing_model_raises(self):
+        with pytest.raises(ValueError):
+            bert_score(["a"], ["a"])
