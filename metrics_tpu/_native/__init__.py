@@ -39,6 +39,13 @@ def _build_lib() -> Optional[ctypes.CDLL]:
                 timeout=120,
             )
             os.replace(tmp, so_path)
+            # drop libraries built from older source revisions
+            for name in os.listdir(_HERE):
+                if name.startswith("_native_") and name.endswith(".so") and name != os.path.basename(so_path):
+                    try:
+                        os.remove(os.path.join(_HERE, name))
+                    except OSError:
+                        pass
         lib = ctypes.CDLL(so_path)
         lib.mtpu_edit_distance.restype = ctypes.c_int64
         lib.mtpu_edit_distance.argtypes = [

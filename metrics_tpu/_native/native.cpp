@@ -77,6 +77,8 @@ void mtpu_rle_decode(const uint32_t* counts, int64_t n_runs, uint8_t* mask, int6
         pos = end;
         v = 1 - v;
     }
+    // zero any canvas tail a truncated run list leaves uncovered
+    if (pos < n) std::memset(mask + pos, 0, n - pos);
 }
 
 // Pairwise IoU between two RLE mask sets given per-mask areas and

@@ -1,0 +1,17 @@
+"""Audio-domain module metrics (reference ``audio/``)."""
+
+from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
+from metrics_tpu.audio.pit import PermutationInvariantTraining
+from metrics_tpu.audio.sdr import ScaleInvariantSignalDistortionRatio, SignalDistortionRatio
+from metrics_tpu.audio.snr import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+from metrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility
+
+__all__ = [
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+]

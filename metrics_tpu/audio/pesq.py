@@ -1,0 +1,43 @@
+"""PESQ module metric (reference ``audio/pesq.py:25-128``)."""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+
+class PerceptualEvaluationSpeechQuality(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jit_update_default = False  # host-side C extension
+
+    def __init__(self, fs: int, mode: str, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PESQ metric requires that `pesq` is installed. It is not bundled with this "
+                "offline build; install `pesq` to enable it."
+            )
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        self.fs = fs
+        self.mode = mode
+        self.add_state("sum_pesq", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pesq_batch = perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode)
+        self.sum_pesq = self.sum_pesq + jnp.sum(pesq_batch)
+        self.total = self.total + pesq_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_pesq / self.total

@@ -1,0 +1,49 @@
+"""PermutationInvariantTraining module metric (reference ``audio/pit.py:22-107``)."""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    # metric_func is an arbitrary Python callable; trace once per shape via
+    # the functional's own jit-friendly body, not the runtime wrapper
+    jit_update_default = False
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in (
+                "compute_on_cpu", "dist_sync_on_step", "sync_on_compute",
+                "dist_sync_fn", "axis_name", "process_group",
+                "jit_update", "jit_compute", "compute_with_cache",
+            )
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(
+            preds, target, self.metric_func, self.eval_func, **self.kwargs
+        )[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

@@ -1,0 +1,39 @@
+"""STOI module metric (reference ``audio/stoi.py:25-125``)."""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jit_update_default = False  # host-side numpy DSP
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that `pystoi` is installed. It is not bundled with this "
+                "offline build; install `pystoi` to enable it."
+            )
+        self.fs = fs
+        self.extended = extended
+        self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        self.sum_stoi = self.sum_stoi + jnp.sum(stoi_batch)
+        self.total = self.total + stoi_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

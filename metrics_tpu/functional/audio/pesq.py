@@ -1,0 +1,54 @@
+"""PESQ wrapper (reference ``functional/audio/pesq.py``).
+
+ITU-T P.862 is a host-side DSP pipeline; like the reference we delegate to the
+optional ``pesq`` C extension (per-sample numpy round-trip) and gate on its
+availability — the metric state (a score sum + count) stays on device.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+) -> Array:
+    """PESQ score per signal (batched over leading dims).
+
+    Requires the optional ``pesq`` package (C extension, host-side).
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that `pesq` is installed. It is not bundled with this "
+            "offline build; install `pesq` to enable it."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+
+    if preds.ndim == 1:
+        pesq_val_np = pesq_backend.pesq(fs, np.asarray(target), np.asarray(preds), mode)
+        pesq_val = jnp.asarray(pesq_val_np, jnp.float32)
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        vals = np.empty(preds_np.shape[0])
+        for b in range(preds_np.shape[0]):
+            vals[b] = pesq_backend.pesq(fs, target_np[b, :], preds_np[b, :], mode)
+        pesq_val = jnp.asarray(vals, jnp.float32).reshape(preds.shape[:-1])
+    return pesq_val

@@ -1,0 +1,48 @@
+"""STOI wrapper (reference ``functional/audio/stoi.py``).
+
+Short-Time Objective Intelligibility via the optional ``pystoi`` package
+(host-side numpy), gated on availability like the reference's extras.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """STOI score per signal (batched over leading dims).
+
+    Requires the optional ``pystoi`` package (host-side).
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that `pystoi` is installed. It is not bundled with this "
+            "offline build; install `pystoi` to enable it."
+        )
+    from pystoi import stoi as stoi_backend
+
+    _check_same_shape(preds, target)
+
+    if preds.ndim == 1:
+        stoi_val = jnp.asarray(
+            stoi_backend(np.asarray(target), np.asarray(preds), fs, extended), jnp.float32
+        )
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        vals = np.empty(preds_np.shape[0])
+        for b in range(preds_np.shape[0]):
+            vals[b] = stoi_backend(target_np[b, :], preds_np[b, :], fs, extended)
+        stoi_val = jnp.asarray(vals, jnp.float32).reshape(preds.shape[:-1])
+    return stoi_val

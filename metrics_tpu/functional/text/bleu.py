@@ -2,6 +2,10 @@
 
 Host-side n-gram counting feeds fixed-shape ``(n_gram,)`` device states, so
 the distributed sync stays a plain ``psum`` over four small tensors.
+
+Deliberate deviation: when two references are equally close in length, the
+shorter one sets the brevity penalty (mteval/sacrebleu/NLTK convention); the
+reference implementation picks the first-listed one instead.
 """
 
 from collections import Counter

@@ -61,6 +61,8 @@ def _preprocess_en(sentence: str) -> str:
         sentence = sentence.replace(p, r)
     sentence = re.sub(r"\s+", " ", sentence)
     sentence = re.sub(r"(\d) ([.,]) (\d)", r"\1\2\3", sentence)
+    # the unescaped '.' (matches any char after the space) replicates the
+    # published EED util.py; kept bug-for-bug so scores match the paper tooling
     sentence = re.sub(r"(Dr|Jr|Prof|Rev|Gen|Mr|Mt|Mrs|Ms) .", r"\1.", sentence)
     for p, r in (("e . g .", "e.g."), ("i . e .", "i.e."), ("U . S .", "U.S.")):
         sentence = sentence.replace(p, r)

@@ -23,16 +23,27 @@ ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
 ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
 
+_NLTK_SPLIT_USABLE: Optional[bool] = None  # probed once, not per sentence
+
+
 def _split_sentence(x: str) -> Sequence[str]:
     """Sentence-split for ROUGE-LSum: nltk when its data is present, else a
     punctuation/newline regex fallback (keeps the metric dependency-free)."""
-    try:
+    global _NLTK_SPLIT_USABLE
+    if _NLTK_SPLIT_USABLE is None:
+        try:
+            import nltk
+
+            nltk.sent_tokenize("probe. probe.")
+            _NLTK_SPLIT_USABLE = True
+        except Exception:
+            _NLTK_SPLIT_USABLE = False
+    if _NLTK_SPLIT_USABLE:
         import nltk
 
         return nltk.sent_tokenize(x)
-    except Exception:
-        parts = re.split(r"(?:(?<=[.!?])\s+)|\n", x.strip())
-        return [p for p in parts if p]
+    parts = re.split(r"(?:(?<=[.!?])\s+)|\n", x.strip())
+    return [p for p in parts if p]
 
 
 def _stat_triple(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:

@@ -10,33 +10,13 @@ from metrics_tpu.functional.text.helper import _edit_distance_batch, _normalize_
 Array = jax.Array
 
 
-def _wil_update(
-    preds: Union[str, List[str]], target: Union[str, List[str]]
-) -> Tuple[Array, Array, Array]:
-    """Return (distance - max_len, total ref words, total pred words).
-
-    ``distance - max(len)`` is the (negative) hit count ``-H``; WIL squares it
-    so the sign cancels — same accumulator trick as the reference
-    (``functional/text/wil.py:51``).
-    """
-    preds = _normalize_str_list(preds)
-    target = _normalize_str_list(target)
-    pred_tok = [p.split() for p in preds]
-    tgt_tok = [t.split() for t in target]
-    dists = _edit_distance_batch(pred_tok, tgt_tok)
-    errors = int(dists.sum())
-    total = sum(max(len(t), len(p)) for t, p in zip(tgt_tok, pred_tok))
-    target_total = sum(len(t) for t in tgt_tok)
-    preds_total = sum(len(p) for p in pred_tok)
-    return (
-        jnp.asarray(errors - total, jnp.float32),
-        jnp.asarray(target_total, jnp.float32),
-        jnp.asarray(preds_total, jnp.float32),
-    )
+# WIL and WIP share the exact accumulator (distance - max_len == -hits);
+# WIL is simply 1 - WIP
+from metrics_tpu.functional.text.wip import _wip_compute, _wip_update as _wil_update
 
 
 def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
-    return 1 - ((errors / target_total) * (errors / preds_total))
+    return 1 - _wip_compute(errors, target_total, preds_total)
 
 
 def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:

@@ -1,0 +1,218 @@
+"""Audio metrics vs independent numpy/scipy references."""
+
+from itertools import permutations
+
+import numpy as np
+import pytest
+import scipy.linalg
+
+from metrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional.audio import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES = 4
+BATCH = 4
+TIME = 256
+
+_rng = np.random.default_rng(7)
+PREDS = _rng.normal(size=(NUM_BATCHES, BATCH, TIME)).astype(np.float32)
+TARGET = (0.8 * PREDS + 0.4 * _rng.normal(size=PREDS.shape)).astype(np.float32)
+
+
+def _ref_snr(preds, target, zero_mean=False):
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    eps = np.finfo(np.float32).eps
+    noise = target - preds
+    return 10 * np.log10(((target**2).sum(-1) + eps) / ((noise**2).sum(-1) + eps))
+
+
+def _ref_si_sdr(preds, target, zero_mean=False):
+    eps = np.finfo(np.float32).eps
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = ((preds * target).sum(-1, keepdims=True) + eps) / ((target**2).sum(-1, keepdims=True) + eps)
+    ts = alpha * target
+    noise = ts - preds
+    return 10 * np.log10(((ts**2).sum(-1) + eps) / ((noise**2).sum(-1) + eps))
+
+
+def _ref_sdr(preds, target, filter_length=128, zero_mean=False):
+    """Independent SDR: scipy solve_toeplitz on float64 correlations."""
+    out = np.empty(preds.shape[:-1])
+    flat_p = preds.reshape(-1, preds.shape[-1]).astype(np.float64)
+    flat_t = target.reshape(-1, target.shape[-1]).astype(np.float64)
+    for i, (p, t) in enumerate(zip(flat_p, flat_t)):
+        if zero_mean:
+            p = p - p.mean()
+            t = t - t.mean()
+        t = t / max(np.linalg.norm(t), 1e-6)
+        p = p / max(np.linalg.norm(p), 1e-6)
+        n_fft = 1 << int(np.ceil(np.log2(len(p) + len(t) - 1)))
+        t_fft = np.fft.rfft(t, n=n_fft)
+        p_fft = np.fft.rfft(p, n=n_fft)
+        r = np.fft.irfft(np.abs(t_fft) ** 2, n=n_fft)[:filter_length]
+        b = np.fft.irfft(np.conj(t_fft) * p_fft, n=n_fft)[:filter_length]
+        sol = scipy.linalg.solve_toeplitz(r, b)
+        coh = np.dot(b, sol)
+        out.reshape(-1)[i] = 10 * np.log10(coh / (1 - coh))
+    return out
+
+
+class TestSNR(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_class(self, zero_mean):
+        self.run_class_metric_test(
+            PREDS, TARGET, SignalNoiseRatio,
+            lambda p, t: _ref_snr(p, t, zero_mean).mean(),
+            metric_args={"zero_mean": zero_mean},
+            ddp=True,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(PREDS, TARGET, signal_noise_ratio, _ref_snr)
+
+
+class TestSiSDR(MetricTester):
+    atol = 1e-3
+
+    def test_class(self):
+        self.run_class_metric_test(
+            PREDS, TARGET, ScaleInvariantSignalDistortionRatio,
+            lambda p, t: _ref_si_sdr(p, t).mean(), ddp=True,
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(PREDS, TARGET, scale_invariant_signal_distortion_ratio, _ref_si_sdr)
+
+    def test_si_snr_equals_zero_mean_si_sdr(self):
+        got = scale_invariant_signal_noise_ratio(PREDS[0], TARGET[0])
+        want = _ref_si_sdr(PREDS[0], TARGET[0], zero_mean=True)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-3)
+
+    def test_si_snr_class(self):
+        self.run_class_metric_test(
+            PREDS, TARGET, ScaleInvariantSignalNoiseRatio,
+            lambda p, t: _ref_si_sdr(p, t, zero_mean=True).mean(),
+        )
+
+
+class TestSDR(MetricTester):
+    atol = 5e-2  # float32 device solve vs float64 scipy reference
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            PREDS, TARGET, signal_distortion_ratio,
+            lambda p, t: _ref_sdr(p, t),
+            metric_args={"filter_length": 128},
+        )
+
+    def test_class(self):
+        self.run_class_metric_test(
+            PREDS, TARGET, SignalDistortionRatio,
+            lambda p, t: _ref_sdr(p, t).mean(),
+            metric_args={"filter_length": 128},
+            ddp=True,
+        )
+
+    def test_zero_mean_and_load_diag(self):
+        got = signal_distortion_ratio(PREDS[0], TARGET[0], filter_length=64, zero_mean=True)
+        want = _ref_sdr(PREDS[0], TARGET[0], filter_length=64, zero_mean=True)
+        np.testing.assert_allclose(np.asarray(got), want, atol=5e-2)
+        out = signal_distortion_ratio(PREDS[0], TARGET[0], filter_length=64, load_diag=1e-5)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+SPK_PREDS = _rng.normal(size=(3, 2, 64)).astype(np.float32)
+SPK_TARGET = _rng.normal(size=(3, 2, 64)).astype(np.float32)
+
+
+def _ref_pit(preds, target, metric, better="max"):
+    batch, spk = preds.shape[:2]
+    best_vals, best_perms = [], []
+    for b in range(batch):
+        best = None
+        for perm in permutations(range(spk)):
+            val = np.mean([metric(preds[b, perm[j]][None], target[b, j][None])[0] for j in range(spk)])
+            if best is None or (val > best[0] if better == "max" else val < best[0]):
+                best = (val, perm)
+        best_vals.append(best[0])
+        best_perms.append(best[1])
+    return np.asarray(best_vals), np.asarray(best_perms)
+
+
+class TestPIT(MetricTester):
+    atol = 1e-3
+
+    def test_functional_matches_bruteforce(self):
+        best, perm = permutation_invariant_training(
+            SPK_PREDS, SPK_TARGET, scale_invariant_signal_distortion_ratio, "max"
+        )
+        ref_best, ref_perm = _ref_pit(SPK_PREDS, SPK_TARGET, _ref_si_sdr, "max")
+        np.testing.assert_allclose(np.asarray(best), ref_best, atol=1e-3)
+        # perm semantics: prediction for target j is perm[b, j]
+        got_vals = []
+        for b in range(SPK_PREDS.shape[0]):
+            p = np.asarray(perm)[b]
+            got_vals.append(np.mean([_ref_si_sdr(SPK_PREDS[b, p[j]][None], SPK_TARGET[b, j][None])[0]
+                                     for j in range(SPK_PREDS.shape[1])]))
+        np.testing.assert_allclose(got_vals, ref_best, atol=1e-3)
+
+    def test_min_mode(self):
+        best, _ = permutation_invariant_training(
+            SPK_PREDS, SPK_TARGET, scale_invariant_signal_distortion_ratio, "min"
+        )
+        ref_best, _ = _ref_pit(SPK_PREDS, SPK_TARGET, _ref_si_sdr, "min")
+        np.testing.assert_allclose(np.asarray(best), ref_best, atol=1e-3)
+
+    def test_permutate(self):
+        best, perm = permutation_invariant_training(
+            SPK_PREDS, SPK_TARGET, scale_invariant_signal_distortion_ratio, "max"
+        )
+        reordered = pit_permutate(SPK_PREDS, perm)
+        vals = _ref_si_sdr(np.asarray(reordered), SPK_TARGET).mean(-1)
+        np.testing.assert_allclose(vals, np.asarray(best), atol=1e-3)
+
+    def test_class_streaming(self):
+        metric = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, "max")
+        metric.update(SPK_PREDS, SPK_TARGET)
+        ref_best, _ = _ref_pit(SPK_PREDS, SPK_TARGET, _ref_si_sdr, "max")
+        np.testing.assert_allclose(float(metric.compute()), ref_best.mean(), atol=1e-3)
+
+    def test_bad_eval_func_raises(self):
+        with pytest.raises(ValueError):
+            permutation_invariant_training(
+                SPK_PREDS, SPK_TARGET, scale_invariant_signal_distortion_ratio, "median"
+            )
+
+
+def test_pesq_stoi_gated():
+    from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+    from metrics_tpu.functional.audio import (
+        perceptual_evaluation_speech_quality,
+        short_time_objective_intelligibility,
+    )
+
+    if not _PESQ_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError):
+            perceptual_evaluation_speech_quality(PREDS[0], TARGET[0], 16000, "wb")
+    if not _PYSTOI_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError):
+            short_time_objective_intelligibility(PREDS[0], TARGET[0], 16000)
