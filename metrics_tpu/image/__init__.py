@@ -1,6 +1,10 @@
 """Image metrics (reference ``src/torchmetrics/image/``)."""
 
 from metrics_tpu.image.d_lambda import SpectralDistortionIndex
+from metrics_tpu.image.fid import FrechetInceptionDistance
+from metrics_tpu.image.inception import InceptionScore
+from metrics_tpu.image.kid import KernelInceptionDistance
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
 from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
 from metrics_tpu.image.psnr import PeakSignalNoiseRatio
 from metrics_tpu.image.sam import SpectralAngleMapper
@@ -11,6 +15,10 @@ from metrics_tpu.image.ssim import (
 from metrics_tpu.image.uqi import UniversalImageQualityIndex
 
 __all__ = [
+    "LearnedPerceptualImagePatchSimilarity",
+    "KernelInceptionDistance",
+    "InceptionScore",
+    "FrechetInceptionDistance",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",

@@ -1,0 +1,13 @@
+"""Flax feature-extractor backbones for the neural image metrics.
+
+The reference buys Inception-v3 / VGG from ``torch-fidelity`` / ``lpips``
+(reference ``image/fid.py:41-58``, ``image/lpip.py:34``); here they are
+first-party Flax modules.  Pretrained weights cannot be downloaded in an
+offline build — pass a params pytree (e.g. converted from the published
+checkpoints via ``load_params_npz``) for score parity, or use random init
+for architecture/shape validation.
+"""
+
+from metrics_tpu.image.backbones.inception import FlaxInceptionV3, InceptionFeatureExtractor
+
+__all__ = ["FlaxInceptionV3", "InceptionFeatureExtractor"]

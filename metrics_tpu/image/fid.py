@@ -1,0 +1,155 @@
+"""Frechet Inception Distance (reference ``image/fid.py``, ~290 LoC).
+
+Two TPU-first redesigns over the reference:
+
+* **Constant-memory state.**  The reference stores every extracted feature
+  vector (``image/fid.py:243-244``) and warns about the memory footprint;
+  here the states are the exact sufficient statistics of the Gaussian fit —
+  per-distribution ``(sum, outer-product sum, count)`` — which are fixed
+  shape, sum-reducible (one ``psum`` syncs them) and stream forever.
+* **XLA-native matrix square root.**  The reference round-trips to CPU
+  through ``scipy.linalg.sqrtm`` (``image/fid.py:61-95``); here
+  ``tr(sqrtm(S1 @ S2))`` is computed on device as the sum of square-rooted
+  eigenvalues of the symmetrized product ``S1^1/2 S2 S1^1/2`` (two ``eigh``
+  calls), keeping compute in float32 with clamped spectra (TPU has weak
+  float64; enable ``jax_enable_x64`` for reference-grade precision).
+"""
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _psd_sqrt(mat: Array) -> Array:
+    """Symmetric PSD square root via eigendecomposition (on-device)."""
+    vals, vecs = jnp.linalg.eigh((mat + mat.T) / 2.0)
+    vals = jnp.clip(vals, 0.0, None)
+    return (vecs * jnp.sqrt(vals)[None, :]) @ vecs.T
+
+def _trace_sqrt_product(sigma1: Array, sigma2: Array, eps: float = 1e-6) -> Array:
+    """``tr(sqrtm(sigma1 @ sigma2))`` without leaving the device.
+
+    Uses the PSD identity: eigenvalues of ``S1 S2`` equal those of
+    ``S1^1/2 S2 S1^1/2`` (symmetric PSD), so the trace of the square root is
+    the sum of their square roots.
+    """
+    s1_half = _psd_sqrt(sigma1 + eps * jnp.eye(sigma1.shape[0], dtype=sigma1.dtype))
+    inner = s1_half @ sigma2 @ s1_half
+    vals = jnp.linalg.eigvalsh((inner + inner.T) / 2.0)
+    return jnp.sum(jnp.sqrt(jnp.clip(vals, 0.0, None)))
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """``|mu1-mu2|^2 + tr(S1 + S2 - 2 sqrtm(S1 S2))`` (reference ``fid.py:97-126``)."""
+    diff = mu1 - mu2
+    tr_covmean = _trace_sqrt_product(sigma1, sigma2)
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+
+
+class FrechetInceptionDistance(Metric):
+    """Streaming FID over a pluggable feature extractor.
+
+    Args:
+        feature: an integer (64/192/768/2048 — built-in Flax Inception-v3
+            tap, random-init unless ``inception_params`` given) or any
+            callable mapping an image batch to ``(N, D)`` features.
+        reset_real_features: keep the real-distribution statistics across
+            ``reset()`` (reference ``image/fid.py:282-289`` caching).
+        feature_dim: required when ``feature`` is a callable.
+    """
+
+    higher_is_better = False
+    is_differentiable = False
+    full_state_update = False
+    jit_update_default = False  # extractor jits internally; `real` is a host bool
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        inception_params: Optional[dict] = None,
+        feature_dim: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, int):
+            from metrics_tpu.image.backbones.inception import (
+                VALID_FEATURE_DIMS,
+                InceptionFeatureExtractor,
+            )
+
+            if feature not in VALID_FEATURE_DIMS:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {list(VALID_FEATURE_DIMS)},"
+                    f" but got {feature}."
+                )
+            if inception_params is None:
+                rank_zero_warn(
+                    "Using a randomly initialized Inception-v3: FID values will be architecture-"
+                    "consistent but not comparable to published scores. Pass `inception_params` "
+                    "(converted pretrained weights) for score parity.",
+                    UserWarning,
+                )
+            self.extractor: Callable = InceptionFeatureExtractor(str(feature), params=inception_params)
+            dim = feature
+        elif callable(feature):
+            if feature_dim is None:
+                raise ValueError("`feature_dim` is required when `feature` is a callable")
+            self.extractor = feature
+            dim = feature_dim
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        self.feature_dim = dim
+        # exact streaming Gaussian statistics; all sum-reducible
+        self.add_state("real_sum", default=jnp.zeros(dim, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32), dist_reduce_fx="sum")
+        self.add_state("real_outer", default=jnp.zeros((dim, dim), jnp.float64 if jax.config.jax_enable_x64 else jnp.float32), dist_reduce_fx="sum")
+        self.add_state("real_n", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("fake_sum", default=jnp.zeros(dim, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32), dist_reduce_fx="sum")
+        self.add_state("fake_outer", default=jnp.zeros((dim, dim), jnp.float64 if jax.config.jax_enable_x64 else jnp.float32), dist_reduce_fx="sum")
+        self.add_state("fake_n", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, imgs: Array, real: bool) -> None:
+        features = jnp.asarray(self.extractor(imgs))
+        features = features.astype(self.real_sum.dtype)
+        if real:
+            self.real_sum = self.real_sum + features.sum(axis=0)
+            self.real_outer = self.real_outer + features.T @ features
+            self.real_n = self.real_n + features.shape[0]
+        else:
+            self.fake_sum = self.fake_sum + features.sum(axis=0)
+            self.fake_outer = self.fake_outer + features.T @ features
+            self.fake_n = self.fake_n + features.shape[0]
+
+    @staticmethod
+    def _mean_cov(total: Array, outer: Array, n: Array):
+        mean = total / n
+        # unbiased covariance from the streaming moments (reference fid.py:273-276)
+        cov = (outer - n * jnp.outer(mean, mean)) / (n - 1)
+        return mean, cov
+
+    def compute(self) -> Array:
+        mu1, sigma1 = self._mean_cov(self.real_sum, self.real_outer, self.real_n)
+        mu2, sigma2 = self._mean_cov(self.fake_sum, self.fake_outer, self.fake_n)
+        return _compute_fid(mu1, sigma1, mu2, sigma2)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            saved = {k: self._state[k] for k in ("real_sum", "real_outer", "real_n")}
+            super().reset()
+            self._state.update(saved)
+        else:
+            super().reset()
+
+    def _reset_for_forward(self) -> None:
+        # full reset: forward's snapshot/merge re-adds preserved real stats,
+        # so keeping them here would double-count them
+        Metric.reset(self)

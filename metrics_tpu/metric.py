@@ -354,11 +354,20 @@ class Metric(ABC):
             return self._forward_full_state_update(*args, **kwargs)
         return self._forward_reduce_state_update(*args, **kwargs)
 
+    def _reset_for_forward(self) -> None:
+        """Reset used by the forward batch-value dance.
+
+        Subclasses that preserve state across *user* resets (e.g. FID's
+        ``reset_real_features=False``) must override this with a FULL reset —
+        the snapshot/merge in forward would double-count preserved state.
+        """
+        self.reset()
+
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         self._update_wrapper(*args, **kwargs)
         cache = self._copy_state()
         cached_count = self._update_count
-        self.reset()
+        self._reset_for_forward()
         self._update_wrapper(*args, **kwargs)
         should_sync = self.dist_sync_on_step
         prev_sync = self.sync_on_compute
@@ -376,7 +385,7 @@ class Metric(ABC):
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         global_state = self._copy_state()
         global_count = self._update_count
-        self.reset()
+        self._reset_for_forward()
         self._update_wrapper(*args, **kwargs)
         prev_sync = self.sync_on_compute
         self.sync_on_compute = False

@@ -1,0 +1,236 @@
+"""FID / IS / KID / LPIPS: metric math vs independent numpy/scipy references.
+
+Feature extractors are stubbed with deterministic callables so the tests
+validate the metric computation (the published-weights path needs converted
+checkpoints, unavailable offline)."""
+
+import numpy as np
+import pytest
+import scipy.linalg
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+)
+from metrics_tpu.image.fid import _compute_fid, _trace_sqrt_product
+
+DIM = 16
+_rng = np.random.default_rng(11)
+
+
+def _rand_cov(d, scale=1.0):
+    a = _rng.normal(size=(d, d))
+    return scale * (a @ a.T) / d + 0.1 * np.eye(d)
+
+
+class TestMatrixSqrt:
+    @pytest.mark.parametrize("scale", [1.0, 10.0, 0.01])
+    def test_trace_sqrt_product_vs_scipy(self, scale):
+        s1 = _rand_cov(DIM, scale)
+        s2 = _rand_cov(DIM)
+        want = np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+        got = float(_trace_sqrt_product(jnp.asarray(s1, jnp.float32), jnp.asarray(s2, jnp.float32)))
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    def test_fid_formula_vs_scipy(self):
+        mu1, mu2 = _rng.normal(size=DIM), _rng.normal(size=DIM)
+        s1, s2 = _rand_cov(DIM), _rand_cov(DIM)
+        want = (
+            np.sum((mu1 - mu2) ** 2)
+            + np.trace(s1) + np.trace(s2)
+            - 2 * np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+        )
+        got = float(_compute_fid(
+            jnp.asarray(mu1, jnp.float32), jnp.asarray(s1, jnp.float32),
+            jnp.asarray(mu2, jnp.float32), jnp.asarray(s2, jnp.float32),
+        ))
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+
+def _feature_stub(imgs):
+    """Deterministic 'extractor': flatten + fixed random projection."""
+    imgs = np.asarray(imgs, dtype=np.float32).reshape(len(imgs), -1)
+    proj = np.random.default_rng(0).normal(size=(imgs.shape[1], DIM)).astype(np.float32)
+    return imgs @ proj / np.sqrt(imgs.shape[1])
+
+
+IMGS_A = _rng.normal(size=(3, 20, 4, 4, 3)).astype(np.float32)
+IMGS_B = (_rng.normal(size=(3, 20, 4, 4, 3)) + 0.5).astype(np.float32)
+
+
+def _ref_fid_from_features(real, fake):
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    s1 = np.cov(real, rowvar=False)
+    s2 = np.cov(fake, rowvar=False)
+    return (
+        np.sum((mu1 - mu2) ** 2)
+        + np.trace(s1) + np.trace(s2)
+        - 2 * np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+    )
+
+
+class TestFID:
+    def test_streaming_matches_ref(self):
+        fid = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM)
+        for batch_r, batch_f in zip(IMGS_A, IMGS_B):
+            fid.update(batch_r, real=True)
+            fid.update(batch_f, real=False)
+        real_feats = _feature_stub(IMGS_A.reshape(-1, *IMGS_A.shape[2:]))
+        fake_feats = _feature_stub(IMGS_B.reshape(-1, *IMGS_B.shape[2:]))
+        want = _ref_fid_from_features(real_feats, fake_feats)
+        np.testing.assert_allclose(float(fid.compute()), want, rtol=5e-2, atol=5e-2)
+
+    def test_reset_real_features_kept(self):
+        fid = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM, reset_real_features=False)
+        fid.update(IMGS_A[0], real=True)
+        real_n = float(fid.real_n)
+        fid.update(IMGS_B[0], real=False)
+        fid.reset()
+        assert float(fid.real_n) == real_n
+        assert float(fid.fake_n) == 0.0
+
+    def test_merge_state_ddp_semantics(self):
+        a = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM)
+        b = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM)
+        a.update(IMGS_A[0], real=True); a.update(IMGS_B[0], real=False)
+        b.update(IMGS_A[1], real=True); b.update(IMGS_B[1], real=False)
+        full = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM)
+        for i in range(2):
+            full.update(IMGS_A[i], real=True); full.update(IMGS_B[i], real=False)
+        a.merge_state(b._state)
+        np.testing.assert_allclose(float(a.compute()), float(full.compute()), rtol=1e-4)
+
+    def test_forward_no_double_count_with_kept_real_features(self):
+        # forward() snapshots + merges state; the reset_real_features=False
+        # override must not preserve real stats through that internal reset
+        fid = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM, reset_real_features=False)
+        fid.update(IMGS_A[0], real=True)
+        assert float(fid.real_n) == IMGS_A[0].shape[0]
+        fid(IMGS_A[1], real=True)
+        assert float(fid.real_n) == IMGS_A[0].shape[0] + IMGS_A[1].shape[0]
+
+    def test_invalid_feature_raises(self):
+        with pytest.raises(ValueError):
+            FrechetInceptionDistance(feature=123)
+        with pytest.raises(ValueError):
+            FrechetInceptionDistance(feature=_feature_stub)  # missing feature_dim
+
+
+def _logits_stub(imgs):
+    imgs = np.asarray(imgs, dtype=np.float32).reshape(len(imgs), -1)
+    proj = np.random.default_rng(1).normal(size=(imgs.shape[1], 10)).astype(np.float32)
+    return imgs @ proj
+
+
+class TestInceptionScore:
+    def test_matches_numpy_reference(self):
+        m = InceptionScore(feature=_logits_stub, splits=2)
+        for batch in IMGS_A:
+            m.update(batch)
+        mean, std = m.compute()
+        # numpy reference with the same shuffle
+        feats = _logits_stub(IMGS_A.reshape(-1, *IMGS_A.shape[2:]))
+        idx = np.asarray(jax.random.permutation(jax.random.PRNGKey(42), feats.shape[0]))
+        feats = feats[idx]
+        ex = np.exp(feats - feats.max(1, keepdims=True))
+        prob = ex / ex.sum(1, keepdims=True)
+        scores = []
+        for chunk in np.array_split(prob, 2, axis=0):
+            marg = chunk.mean(0, keepdims=True)
+            kl = (chunk * (np.log(chunk) - np.log(marg))).sum(1).mean()
+            scores.append(np.exp(kl))
+        np.testing.assert_allclose(float(mean), np.mean(scores), rtol=1e-4)
+        np.testing.assert_allclose(float(std), np.std(scores, ddof=1), rtol=1e-3, atol=1e-6)
+
+
+def _ref_poly_mmd(f_real, f_fake, degree=3, coef=1.0):
+    gamma = 1.0 / f_real.shape[1]
+    k_xx = (f_real @ f_real.T * gamma + coef) ** degree
+    k_yy = (f_fake @ f_fake.T * gamma + coef) ** degree
+    k_xy = (f_real @ f_fake.T * gamma + coef) ** degree
+    m = k_xx.shape[0]
+    val = ((k_xx.sum() - np.trace(k_xx)) + (k_yy.sum() - np.trace(k_yy))) / (m * (m - 1))
+    return val - 2 * k_xy.sum() / m**2
+
+
+class TestKID:
+    def test_subsets_cover_reference_mmd_scale(self):
+        m = KernelInceptionDistance(
+            feature=_feature_stub, subsets=4, subset_size=30,
+        )
+        for br, bf in zip(IMGS_A, IMGS_B):
+            m.update(br, real=True)
+            m.update(bf, real=False)
+        mean, std = m.compute()
+        # whole-set MMD as scale reference (subset estimates scatter around it)
+        real = _feature_stub(IMGS_A.reshape(-1, *IMGS_A.shape[2:]))
+        fake = _feature_stub(IMGS_B.reshape(-1, *IMGS_B.shape[2:]))
+        full = _ref_poly_mmd(real, fake)
+        assert np.isfinite(float(mean)) and np.isfinite(float(std))
+        assert abs(float(mean) - full) < max(5 * abs(full), 1.0)
+
+    def test_subset_size_too_large_raises(self):
+        m = KernelInceptionDistance(feature=_feature_stub, subsets=2, subset_size=10_000)
+        m.update(IMGS_A[0], real=True)
+        m.update(IMGS_B[0], real=False)
+        with pytest.raises(ValueError):
+            m.compute()
+
+    def test_mmd_exact_on_fixed_subset(self):
+        from metrics_tpu.image.kid import poly_mmd
+
+        real = _feature_stub(IMGS_A.reshape(-1, *IMGS_A.shape[2:]))[:25]
+        fake = _feature_stub(IMGS_B.reshape(-1, *IMGS_B.shape[2:]))[:25]
+        got = float(poly_mmd(jnp.asarray(real), jnp.asarray(fake)))
+        np.testing.assert_allclose(got, _ref_poly_mmd(real, fake), rtol=1e-4)
+
+
+class TestLPIPS:
+    def test_streaming_and_properties(self):
+        m = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        img1 = np.clip(_rng.normal(size=(4, 3, 32, 32)), -1, 1).astype(np.float32)
+        img2 = np.clip(_rng.normal(size=(4, 3, 32, 32)), -1, 1).astype(np.float32)
+        m.update(img1, img2)
+        val = float(m.compute())
+        assert np.isfinite(val) and val >= 0
+        # identical images -> 0 distance
+        m2 = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        m2.update(img1, img1)
+        np.testing.assert_allclose(float(m2.compute()), 0.0, atol=1e-5)
+
+    def test_sum_reduction_and_normalize(self):
+        img1 = np.random.default_rng(0).random((2, 3, 16, 16)).astype(np.float32)
+        img2 = np.random.default_rng(1).random((2, 3, 16, 16)).astype(np.float32)
+        m = LearnedPerceptualImagePatchSimilarity(net_type="squeeze", reduction="sum", normalize=True)
+        m.update(img1, img2)
+        assert np.isfinite(float(m.compute()))
+
+    def test_invalid_args_raise(self):
+        with pytest.raises(ValueError):
+            LearnedPerceptualImagePatchSimilarity(net_type="resnet")
+        with pytest.raises(ValueError):
+            LearnedPerceptualImagePatchSimilarity(reduction="max")
+
+
+class TestBackboneShapes:
+    @pytest.mark.parametrize("tap,dim", [("64", 64), ("192", 192), ("768", 768), ("2048", 2048)])
+    def test_inception_taps(self, tap, dim):
+        from metrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+
+        ext = InceptionFeatureExtractor(tap)
+        imgs = (np.random.default_rng(0).random((2, 3, 32, 32)) * 255).astype(np.uint8)
+        out = np.asarray(ext(imgs))
+        assert out.shape == (2, dim)
+
+    def test_logits_tap(self):
+        from metrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+
+        ext = InceptionFeatureExtractor("logits_unbiased")
+        imgs = (np.random.default_rng(0).random((2, 3, 32, 32)) * 255).astype(np.uint8)
+        out = np.asarray(ext(imgs))
+        assert out.shape == (2, 1008)
