@@ -1,0 +1,235 @@
+"""COCO mAP vs official pycocotools numbers.
+
+The box fixture is the COCO-val subset (image ids 42/73/74/133) whose
+expected values were produced by running the official pycocotools COCOeval —
+the strongest available oracle in an offline build."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.detection.mean_ap import box_convert, box_iou
+
+PREDS = [
+    [
+        dict(boxes=np.array([[258.15, 41.29, 606.41, 285.07]]),
+             scores=np.array([0.236]), labels=np.array([4])),  # coco image id 42
+        dict(boxes=np.array([[61.00, 22.75, 565.00, 632.42],
+                             [12.66, 3.32, 281.26, 275.23]]),
+             scores=np.array([0.318, 0.726]), labels=np.array([3, 2])),  # id 73
+    ],
+    [
+        dict(boxes=np.array([[87.87, 276.25, 384.29, 379.43],
+                             [0.00, 3.66, 142.15, 316.06],
+                             [296.55, 93.96, 314.97, 152.79],
+                             [328.94, 97.05, 342.49, 122.98],
+                             [356.62, 95.47, 372.33, 147.55],
+                             [464.08, 105.09, 495.74, 146.99],
+                             [276.11, 103.84, 291.44, 150.72]]),
+             scores=np.array([0.546, 0.3, 0.407, 0.611, 0.335, 0.805, 0.953]),
+             labels=np.array([4, 1, 0, 0, 0, 0, 0])),  # id 74
+        dict(boxes=np.array([[0.00, 2.87, 601.00, 421.52]]),
+             scores=np.array([0.699]), labels=np.array([5])),  # id 133
+    ],
+]
+TARGET = [
+    [
+        dict(boxes=np.array([[214.15, 41.29, 562.41, 285.07]]), labels=np.array([4])),
+        dict(boxes=np.array([[13.00, 22.75, 548.98, 632.42],
+                             [1.66, 3.32, 270.26, 275.23]]), labels=np.array([2, 2])),
+    ],
+    [
+        dict(boxes=np.array([[61.87, 276.25, 358.29, 379.43],
+                             [2.75, 3.66, 162.15, 316.06],
+                             [295.55, 93.96, 313.97, 152.79],
+                             [326.94, 97.05, 340.49, 122.98],
+                             [356.62, 95.47, 372.33, 147.55],
+                             [462.08, 105.09, 493.74, 146.99],
+                             [277.11, 103.84, 292.44, 150.72]]),
+             labels=np.array([4, 1, 0, 0, 0, 0, 0])),
+        dict(boxes=np.array([[13.99, 2.87, 640.00, 421.52]]), labels=np.array([5])),
+    ],
+]
+
+# official pycocotools COCOeval output for this subset
+PYCOCO_EXPECTED = {
+    "map": 0.706, "map_50": 0.901, "map_75": 0.846,
+    "map_small": 0.689, "map_medium": 0.800, "map_large": 0.701,
+    "mar_1": 0.592, "mar_10": 0.716, "mar_100": 0.716,
+    "mar_small": 0.767, "mar_medium": 0.800, "mar_large": 0.700,
+}
+PYCOCO_PER_CLASS = {
+    "map_per_class": [0.725, 0.800, 0.454, -1.000, 0.650, 0.900],
+    "mar_100_per_class": [0.780, 0.800, 0.450, -1.000, 0.650, 0.900],
+}
+
+
+class TestMAPvsPycocotools:
+    def test_full_protocol(self):
+        metric = MeanAveragePrecision(class_metrics=True)
+        for p, t in zip(PREDS, TARGET):
+            metric.update(p, t)
+        res = metric.compute()
+        for key, want in PYCOCO_EXPECTED.items():
+            np.testing.assert_allclose(float(res[key]), want, atol=2e-3, err_msg=key)
+        for key, want in PYCOCO_PER_CLASS.items():
+            np.testing.assert_allclose(np.asarray(res[key]), want, atol=2e-3, err_msg=key)
+
+    def test_post_sync_flat_state_reconstructs(self):
+        # a collective sync cat-flattens the per-image list states; compute
+        # must rebuild image boundaries from the counts states
+        import jax.numpy as jnp
+
+        metric = MeanAveragePrecision(class_metrics=True)
+        for p, t in zip(PREDS, TARGET):
+            metric.update(p, t)
+        want = float(metric.compute()["map"])
+        flat = MeanAveragePrecision(class_metrics=True)
+        for p, t in zip(PREDS, TARGET):
+            flat.update(p, t)
+        for name in (
+            "detections", "detection_scores", "detection_labels", "detection_counts",
+            "groundtruths", "groundtruth_labels", "groundtruth_counts",
+        ):
+            flat._state[name] = jnp.concatenate([jnp.atleast_1d(x) for x in flat._state[name]], axis=0)
+        flat.sync_on_compute = False
+        flat._update_count = 1
+        np.testing.assert_allclose(float(flat.compute()["map"]), want, atol=1e-6)
+
+    def test_merge_state_matches_single(self):
+        a = MeanAveragePrecision()
+        b = MeanAveragePrecision()
+        a.update(PREDS[0], TARGET[0])
+        b.update(PREDS[1], TARGET[1])
+        a.merge_state(b._state)
+        full = MeanAveragePrecision()
+        for p, t in zip(PREDS, TARGET):
+            full.update(p, t)
+        np.testing.assert_allclose(float(a.compute()["map"]), float(full.compute()["map"]), atol=1e-6)
+
+
+class TestMAPEdgeCases:
+    def test_perfect_predictions(self):
+        boxes = np.array([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 120.0, 120.0]])
+        metric = MeanAveragePrecision()
+        metric.update(
+            [dict(boxes=boxes, scores=np.array([0.9, 0.8]), labels=np.array([0, 1]))],
+            [dict(boxes=boxes, labels=np.array([0, 1]))],
+        )
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+    def test_empty_preds(self):
+        metric = MeanAveragePrecision()
+        metric.update(
+            [dict(boxes=np.zeros((0, 4)), scores=np.zeros(0), labels=np.zeros(0, np.int64))],
+            [dict(boxes=np.array([[1.0, 2.0, 3.0, 4.0]]), labels=np.array([1]))],
+        )
+        res = metric.compute()
+        assert float(res["map"]) == 0.0
+
+    def test_empty_ground_truths(self):
+        metric = MeanAveragePrecision()
+        metric.update(
+            [dict(boxes=np.array([[1.0, 2.0, 3.0, 4.0]]), scores=np.array([0.8]), labels=np.array([1]))],
+            [dict(boxes=np.zeros((0, 4)), labels=np.zeros(0, np.int64))],
+        )
+        res = metric.compute()
+        # no positives anywhere -> all cells empty -> -1 sentinels
+        assert float(res["map"]) == -1.0
+
+    def test_missing_gt_image_lowers_map(self):
+        # image 2 has predictions but no ground truth: those are false positives
+        metric = MeanAveragePrecision()
+        gt_boxes = np.array([[10.0, 10.0, 50.0, 50.0]])
+        metric.update(
+            [
+                dict(boxes=gt_boxes, scores=np.array([0.9]), labels=np.array([0])),
+                dict(boxes=np.array([[5.0, 5.0, 30.0, 30.0]]), scores=np.array([0.95]), labels=np.array([0])),
+            ],
+            [
+                dict(boxes=gt_boxes, labels=np.array([0])),
+                dict(boxes=np.zeros((0, 4)), labels=np.zeros(0, np.int64)),
+            ],
+        )
+        res = metric.compute()
+        assert 0.0 < float(res["map"]) < 1.0
+
+    @pytest.mark.parametrize("fmt,box", [
+        ("xywh", [10.0, 10.0, 40.0, 40.0]),
+        ("cxcywh", [30.0, 30.0, 40.0, 40.0]),
+    ])
+    def test_box_formats(self, fmt, box):
+        # all formats describe the same square [10,10,50,50]
+        metric = MeanAveragePrecision(box_format=fmt)
+        metric.update(
+            [dict(boxes=np.array([box]), scores=np.array([0.9]), labels=np.array([0]))],
+            [dict(boxes=np.array([box]), labels=np.array([0]))],
+        )
+        np.testing.assert_allclose(float(metric.compute()["map"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(
+            box_convert(np.array([box]), fmt), np.array([[10.0, 10.0, 50.0, 50.0]])
+        )
+
+    def test_max_detection_cap(self):
+        # 3 correct dets but max_detection_thresholds=[1]: recall capped at 1/3
+        boxes = np.array([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0], [40.0, 40.0, 50.0, 50.0]])
+        metric = MeanAveragePrecision(max_detection_thresholds=[1])
+        metric.update(
+            [dict(boxes=boxes, scores=np.array([0.9, 0.8, 0.7]), labels=np.array([0, 0, 0]))],
+            [dict(boxes=boxes, labels=np.array([0, 0, 0]))],
+        )
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["mar_1"]), 1 / 3, atol=1e-6)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            MeanAveragePrecision(box_format="abc")
+        with pytest.raises(ValueError):
+            MeanAveragePrecision(iou_type="bad")
+        with pytest.raises(ValueError):
+            MeanAveragePrecision(class_metrics="yes")
+        metric = MeanAveragePrecision()
+        with pytest.raises(ValueError):
+            metric.update([dict(scores=np.zeros(1), labels=np.zeros(1))], [dict(boxes=np.zeros((1, 4)), labels=np.zeros(1))])
+
+
+class TestBoxOps:
+    def test_iou_vs_reference(self):
+        rng = np.random.default_rng(0)
+        a = np.sort(rng.random((8, 2, 2)) * 100, axis=1).reshape(8, 4)[:, [0, 2, 1, 3]]
+        b = np.sort(rng.random((5, 2, 2)) * 100, axis=1).reshape(5, 4)[:, [0, 2, 1, 3]]
+        got = box_iou(a, b)
+        for i in range(8):
+            for j in range(5):
+                xa1, ya1, xa2, ya2 = a[i]
+                xb1, yb1, xb2, yb2 = b[j]
+                iw = max(0.0, min(xa2, xb2) - max(xa1, xb1))
+                ih = max(0.0, min(ya2, yb2) - max(ya1, yb1))
+                inter = iw * ih
+                union = (xa2 - xa1) * (ya2 - ya1) + (xb2 - xb1) * (yb2 - yb1) - inter
+                np.testing.assert_allclose(got[i, j], inter / union if union > 0 else 0.0, atol=1e-9)
+
+
+class TestSegmIoU:
+    def test_mask_map_perfect_and_half(self):
+        h = w = 32
+        m1 = np.zeros((h, w), np.uint8); m1[4:20, 4:20] = 1
+        m2 = np.zeros((h, w), np.uint8); m2[10:28, 10:28] = 1
+        metric = MeanAveragePrecision(iou_type="segm")
+        metric.update(
+            [dict(masks=np.stack([m1, m2]).astype(bool), scores=np.array([0.9, 0.8]), labels=np.array([0, 1]))],
+            [dict(masks=np.stack([m1, m2]).astype(bool), labels=np.array([0, 1]))],
+        )
+        res = metric.compute()
+        np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+
+        # disjoint masks -> no matches -> map 0
+        m3 = np.zeros((h, w), np.uint8); m3[0:4, 0:4] = 1
+        metric2 = MeanAveragePrecision(iou_type="segm")
+        metric2.update(
+            [dict(masks=m3[None].astype(bool), scores=np.array([0.9]), labels=np.array([0]))],
+            [dict(masks=m1[None].astype(bool), labels=np.array([0]))],
+        )
+        assert float(metric2.compute()["map"]) == 0.0
