@@ -63,10 +63,122 @@ def _bench_torch_reference(n_batches: int = 50, batch_size: int = 8192, num_clas
     return (n_batches * batch_size) / elapsed
 
 
+def _bench_collection(n_batches: int = 20, batch_size: int = 4096, num_classes: int = 10):
+    """BASELINE config 2: ConfusionMatrix + F1 collection (compute groups)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import ConfusionMatrix, F1Score, MetricCollection
+
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.integers(0, num_classes, size=(n_batches, batch_size)))
+    target = jnp.asarray(rng.integers(0, num_classes, size=(n_batches, batch_size)))
+    col = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=num_classes, validate_args=False),
+            "f1": F1Score(num_classes=num_classes, average="macro", validate_args=False),
+        }
+    )
+    col.update(preds[0], target[0])
+    jax.block_until_ready(jax.tree_util.tree_leaves(col.compute()))
+    start = time.perf_counter()
+    for i in range(n_batches):
+        col.update(preds[i], target[i])
+    jax.block_until_ready(jax.tree_util.tree_leaves(col.compute()))
+    return (n_batches * batch_size) / (time.perf_counter() - start)
+
+
+def _bench_image(n_batches: int = 5, batch_size: int = 8):
+    """BASELINE config 3: PSNR + SSIM + FID (stub features keep it bench-fast)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import FrechetInceptionDistance, PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure
+
+    rng = np.random.default_rng(2)
+    imgs_a = jnp.asarray(rng.random((n_batches, batch_size, 3, 64, 64), dtype=np.float32))
+    imgs_b = jnp.clip(imgs_a + 0.05 * jnp.asarray(rng.random(imgs_a.shape, dtype=np.float32)), 0, 1)
+    psnr = PeakSignalNoiseRatio(data_range=1.0)
+    ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+
+    dim = 64
+    proj = jnp.asarray(np.random.default_rng(0).normal(size=(3 * 64 * 64, dim)), jnp.float32)
+    feat = jax.jit(lambda x: x.reshape(x.shape[0], -1) @ proj)
+    fid = FrechetInceptionDistance(feature=feat, feature_dim=dim)
+
+    psnr.update(imgs_a[0], imgs_b[0])
+    ssim.update(imgs_a[0], imgs_b[0])
+    fid.update(imgs_a[0], real=True)
+    fid.update(imgs_b[0], real=False)
+    jax.block_until_ready(fid.compute())
+    for m in (psnr, ssim):
+        jax.block_until_ready(m.compute())
+        m.reset()
+    fid.reset()
+
+    start = time.perf_counter()
+    for i in range(n_batches):
+        psnr.update(imgs_a[i], imgs_b[i])
+        ssim.update(imgs_a[i], imgs_b[i])
+        fid.update(imgs_a[i], real=True)
+        fid.update(imgs_b[i], real=False)
+    jax.block_until_ready(psnr.compute())
+    jax.block_until_ready(ssim.compute())
+    jax.block_until_ready(fid.compute())
+    return (n_batches * batch_size) / (time.perf_counter() - start)
+
+
+def _bench_text(n_batches: int = 4):
+    """BASELINE config 4: ROUGE over synthetic sentences (host pipeline)."""
+    from metrics_tpu import ROUGEScore
+
+    rng = np.random.default_rng(3)
+    vocab = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta"]
+    def sent():
+        return " ".join(rng.choice(vocab, size=12))
+    batches = [([sent() for _ in range(32)], [sent() for _ in range(32)]) for _ in range(n_batches)]
+    rouge = ROUGEScore(rouge_keys=("rouge1", "rouge2", "rougeL"))
+    start = time.perf_counter()
+    for preds, target in batches:
+        rouge.update(preds, target)
+    rouge.compute()
+    return (n_batches * 32) / (time.perf_counter() - start)
+
+
+def _bench_detection(n_imgs: int = 64):
+    """BASELINE config 5: COCO-protocol mAP over synthetic detections."""
+    from metrics_tpu import MeanAveragePrecision
+
+    rng = np.random.default_rng(4)
+    metric = MeanAveragePrecision()
+    preds, targets = [], []
+    for _ in range(n_imgs):
+        n = int(rng.integers(1, 8))
+        gt = np.sort(rng.random((n, 2, 2)) * 300, axis=1).reshape(n, 4)
+        jitter = gt + rng.normal(scale=4.0, size=gt.shape)
+        preds.append(dict(boxes=jitter, scores=rng.random(n), labels=rng.integers(0, 5, n)))
+        targets.append(dict(boxes=gt, labels=rng.integers(0, 5, n)))
+    start = time.perf_counter()
+    metric.update(preds, targets)
+    metric.compute()
+    return n_imgs / (time.perf_counter() - start)
+
+
 def main() -> None:
     ups, _value = _bench_accuracy()
     ref = _bench_torch_reference()
     vs_baseline = (ups / ref) if ref else 1.0
+    extra = {}
+    for name, fn in (
+        ("collection_samples_per_sec", _bench_collection),
+        ("image_psnr_ssim_fid_samples_per_sec", _bench_image),
+        ("rouge_sentences_per_sec", _bench_text),
+        ("map_images_per_sec", _bench_detection),
+    ):
+        try:
+            extra[name] = round(fn(), 1)
+        except Exception as err:  # never let a secondary config break the line
+            extra[name] = f"error: {type(err).__name__}"
     print(
         json.dumps(
             {
@@ -74,6 +186,7 @@ def main() -> None:
                 "value": round(ups, 1),
                 "unit": "samples/s",
                 "vs_baseline": round(vs_baseline, 3),
+                "extra": extra,
             }
         )
     )

@@ -1,0 +1,29 @@
+"""Example: COCO-protocol mAP over streaming detection batches
+(reference ``examples/detection_map.py`` analog)."""
+
+import numpy as np
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+
+def main() -> None:
+    metric = MeanAveragePrecision(box_format="xyxy", class_metrics=True)
+    rng = np.random.default_rng(0)
+    for _step in range(4):
+        preds, targets = [], []
+        for _img in range(8):
+            n = int(rng.integers(1, 6))
+            gt = np.sort(rng.random((n, 2, 2)) * 300, axis=1).reshape(n, 4)
+            noisy = gt + rng.normal(scale=3.0, size=gt.shape)
+            labels = rng.integers(0, 3, n)
+            preds.append(dict(boxes=noisy, scores=rng.random(n), labels=labels))
+            targets.append(dict(boxes=gt, labels=labels))
+        metric.update(preds, targets)
+    result = metric.compute()
+    for key in ("map", "map_50", "map_75", "mar_100"):
+        print(f"{key}: {float(result[key]):.4f}")
+    print("per-class AP:", np.asarray(result["map_per_class"]).round(4))
+
+
+if __name__ == "__main__":
+    main()

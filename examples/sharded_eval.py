@@ -1,0 +1,40 @@
+"""Example: data-parallel metric evaluation over a device mesh.
+
+Run with real TPU chips, or simulate locally:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu python examples/sharded_eval.py``
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy
+
+
+def main() -> None:
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    metric = Accuracy(num_classes=10, validate_args=False)
+
+    rng = np.random.default_rng(0)
+    batch = 64 * n_dev
+    preds = jnp.asarray(rng.random((batch, 10), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 10, size=(batch,)))
+
+    def eval_step(p_shard, t_shard):
+        state = metric.init_state()
+        state = metric.apply_update(state, p_shard, t_shard)
+        # psum over the mesh: every device returns the global value
+        return jnp.asarray(metric.apply_compute(state, axis_name="data"))[None]
+
+    fn = jax.jit(
+        jax.shard_map(eval_step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"))
+    )
+    values = np.asarray(fn(preds, target))
+    print(f"devices: {n_dev}, per-device global accuracy: {values.ravel()}")
+    assert np.allclose(values, values[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Example: corpus-level MT/summarization scoring with BLEU, chrF, TER and
+ROUGE (reference ``examples/rouge_score-own_normalizer.py`` analog)."""
+
+from metrics_tpu.text import BLEUScore, CHRFScore, ROUGEScore, TranslationEditRate
+
+
+def main() -> None:
+    hypotheses = [
+        "the cat is on the mat",
+        "there is a dog in the garden",
+    ]
+    references = [
+        ["a cat is on the mat", "the cat sits on the mat"],
+        ["a dog is in the garden"],
+    ]
+
+    bleu = BLEUScore()
+    chrf = CHRFScore()
+    ter = TranslationEditRate()
+    bleu.update(hypotheses, references)
+    chrf.update(hypotheses, references)
+    ter.update(hypotheses, references)
+    print(f"BLEU: {float(bleu.compute()):.4f}")
+    print(f"chrF++: {float(chrf.compute()):.4f}")
+    print(f"TER: {float(ter.compute()):.4f}")
+
+    rouge = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+    rouge.update("the quick brown fox", "a quick brown dog")
+    for name, value in rouge.compute().items():
+        print(f"{name}: {float(value):.4f}")
+
+
+if __name__ == "__main__":
+    main()

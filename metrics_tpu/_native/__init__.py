@@ -75,6 +75,14 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
         ]
+        lib.mtpu_coco_match.restype = None
+        lib.mtpu_coco_match.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
         return lib
     except Exception:
         return None
@@ -176,6 +184,39 @@ def edit_distance_batch(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# COCO greedy matching
+# ---------------------------------------------------------------------------
+def coco_match(ious: np.ndarray, gt_ignore: np.ndarray, thresholds: np.ndarray):
+    """Greedy COCO matching across all thresholds; None if no native lib.
+
+    Args: ious (n_det, n_gt) float64 (dets score-sorted, gts
+    non-ignored-first), gt_ignore (n_gt,) bool, thresholds (T,) float64.
+    Returns (det_match (T, n_det) int64, det_ignore (T, n_det) bool,
+    gt_matched (T, n_gt) bool).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    ious = np.ascontiguousarray(ious, dtype=np.float64)
+    gt_ignore_u8 = np.ascontiguousarray(gt_ignore, dtype=np.uint8)
+    thresholds = np.ascontiguousarray(thresholds, dtype=np.float64)
+    n_det, n_gt = ious.shape
+    T = len(thresholds)
+    det_match = np.empty((T, n_det), dtype=np.int64)
+    det_ignore = np.zeros((T, n_det), dtype=np.uint8)
+    gt_matched = np.zeros((T, n_gt), dtype=np.uint8)
+    lib.mtpu_coco_match(
+        ious.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n_det, n_gt,
+        gt_ignore_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        thresholds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), T,
+        det_match.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        det_ignore.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        gt_matched.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return det_match, det_ignore.astype(bool), gt_matched.astype(bool)
 
 
 # ---------------------------------------------------------------------------

@@ -108,4 +108,34 @@ int64_t mtpu_rle_intersection(const uint32_t* a, int64_t na, const uint32_t* b, 
     return inter;
 }
 
+// Greedy COCO detection matching for all IoU thresholds in one call.
+// ious is row-major (n_det, n_gt) with detections pre-sorted by score and
+// ground truths sorted non-ignored-first; outputs are (T, n_det)/(T, n_gt).
+void mtpu_coco_match(const double* ious, int64_t n_det, int64_t n_gt,
+                     const uint8_t* gt_ignore, const double* thresholds, int64_t n_thr,
+                     int64_t* det_match, uint8_t* det_ignore, uint8_t* gt_matched) {
+    for (int64_t ti = 0; ti < n_thr; ++ti) {
+        int64_t* dm = det_match + ti * n_det;
+        uint8_t* dig = det_ignore + ti * n_det;
+        uint8_t* gm = gt_matched + ti * n_gt;
+        for (int64_t d = 0; d < n_det; ++d) {
+            double best_iou = std::min(thresholds[ti], 1.0 - 1e-10);
+            int64_t best_g = -1;
+            const double* row = ious + d * n_gt;
+            for (int64_t g = 0; g < n_gt; ++g) {
+                if (gm[g]) continue;
+                // gts sorted non-ignored first: stop at the ignored region
+                // once a real match exists
+                if (best_g > -1 && !gt_ignore[best_g] && gt_ignore[g]) break;
+                if (row[g] < best_iou) continue;
+                best_iou = row[g];
+                best_g = g;
+            }
+            dm[d] = best_g;
+            dig[d] = (best_g > -1) ? gt_ignore[best_g] : 0;
+            if (best_g > -1) gm[best_g] = 1;
+        }
+    }
+}
+
 }  // extern "C"

@@ -33,7 +33,9 @@ Array = jax.Array
 # ---------------------------------------------------------------------------
 def box_convert(boxes: np.ndarray, in_fmt: str) -> np.ndarray:
     """Convert ``xywh``/``cxcywh`` boxes to ``xyxy``."""
-    boxes = np.asarray(boxes, dtype=np.float64).reshape(-1, 4)
+    # always copy: stored state must not alias caller buffers (dataloaders
+    # commonly reuse preallocated arrays between batches)
+    boxes = np.array(boxes, dtype=np.float64, copy=True).reshape(-1, 4)
     if in_fmt == "xyxy":
         return boxes
     out = boxes.copy()
@@ -94,6 +96,12 @@ def _match_image(
              det_ignore (T, n_det) bool,
              gt_matched (T, n_gt) bool).
     """
+    from metrics_tpu._native import coco_match
+
+    native = coco_match(ious, gt_ignore, thresholds)
+    if native is not None:
+        return native
+
     n_det, n_gt = ious.shape
     T = len(thresholds)
     det_match = np.full((T, n_det), -1, dtype=np.int64)
@@ -216,24 +224,27 @@ class MeanAveragePrecision(Metric):
 
     def update(self, preds: List[Dict[str, Any]], target: List[Dict[str, Any]]) -> None:
         self._input_validator(preds, target, self.iou_type)
+        # states stay host-side numpy: the whole protocol is host-orchestrated,
+        # and device-resident list entries would pay one device->host transfer
+        # per image per state at compute time (catastrophic over a TPU tunnel)
         for item_p, item_t in zip(preds, target):
             if self.iou_type == "segm":
                 det_masks = np.asarray(item_p["masks"]).astype(np.uint8)
                 gt_masks = np.asarray(item_t["masks"]).astype(np.uint8)
-                self.detection_masks.append(jnp.asarray(det_masks))
-                self.groundtruth_masks.append(jnp.asarray(gt_masks))
+                self.detection_masks.append(det_masks)
+                self.groundtruth_masks.append(gt_masks)
                 det_boxes = np.zeros((len(det_masks), 4))
                 gt_boxes = np.zeros((len(gt_masks), 4))
             else:
                 det_boxes = box_convert(np.asarray(item_p["boxes"]), self.box_format)
                 gt_boxes = box_convert(np.asarray(item_t["boxes"]), self.box_format)
-            self.detections.append(jnp.asarray(det_boxes.reshape(-1, 4)))
-            self.detection_scores.append(jnp.asarray(np.asarray(item_p["scores"], dtype=np.float64).reshape(-1)))
-            self.detection_labels.append(jnp.asarray(np.asarray(item_p["labels"], dtype=np.int64).reshape(-1)))
-            self.detection_counts.append(jnp.asarray([det_boxes.shape[0]], jnp.int32))
-            self.groundtruths.append(jnp.asarray(gt_boxes.reshape(-1, 4)))
-            self.groundtruth_labels.append(jnp.asarray(np.asarray(item_t["labels"], dtype=np.int64).reshape(-1)))
-            self.groundtruth_counts.append(jnp.asarray([gt_boxes.shape[0]], jnp.int32))
+            self.detections.append(det_boxes.reshape(-1, 4))
+            self.detection_scores.append(np.array(item_p["scores"], dtype=np.float64, copy=True).reshape(-1))
+            self.detection_labels.append(np.array(item_p["labels"], dtype=np.int64, copy=True).reshape(-1))
+            self.detection_counts.append(np.asarray([det_boxes.shape[0]], np.int32))
+            self.groundtruths.append(gt_boxes.reshape(-1, 4))
+            self.groundtruth_labels.append(np.array(item_t["labels"], dtype=np.int64, copy=True).reshape(-1))
+            self.groundtruth_counts.append(np.asarray([gt_boxes.shape[0]], np.int32))
 
     # ------------------------------------------------------------ compute
     def _area(self, boxes: np.ndarray, masks: Optional[List[np.ndarray]]) -> np.ndarray:

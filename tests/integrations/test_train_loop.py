@@ -1,0 +1,93 @@
+"""Training-framework integration (reference L6:
+``tests/integrations/test_lightning.py`` — here the host framework is a
+jit-compiled Flax/optax train loop instead of Lightning)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from metrics_tpu import Accuracy, F1Score, MetricCollection
+
+
+class _TinyNet(nn.Module):
+    classes: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.classes)(x)
+
+
+def test_metric_inside_jitted_train_step():
+    """The idiomatic embedding: pure metric kernels inside the jitted step."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(128, 8)).astype(np.float32)
+    W = rng.normal(size=(8,))
+    y = (X @ W > 0).astype(np.int32) + 2 * (X[:, 0] > 0).astype(np.int32)
+
+    model = _TinyNet()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    metric = Accuracy(num_classes=4, validate_args=False)
+
+    @jax.jit
+    def train_step(params, opt_state, metric_state, xb, yb):
+        def loss_fn(p):
+            logits = model.apply(p, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        metric_state = metric.apply_update(metric_state, jax.nn.softmax(logits), yb)
+        return params, opt_state, metric_state, loss
+
+    accs = []
+    for epoch in range(8):
+        metric_state = metric.init_state()
+        for s in range(0, 128, 32):
+            params, opt_state, metric_state, loss = train_step(
+                params, opt_state, metric_state, jnp.asarray(X[s : s + 32]), jnp.asarray(y[s : s + 32])
+            )
+        accs.append(float(metric.apply_compute(metric_state)))
+    assert accs[-1] > accs[0], accs  # training improves the logged metric
+    assert accs[-1] > 0.5
+
+
+def test_collection_in_eval_loop_object_style():
+    """Object-style epoch loop: forward per batch, compute at epoch end."""
+    rng = np.random.default_rng(1)
+    col = MetricCollection(
+        {"acc": Accuracy(num_classes=3, validate_args=False),
+         "f1": F1Score(num_classes=3, average="macro", validate_args=False)}
+    )
+    for _ in range(3):
+        preds = jnp.asarray(rng.random((16, 3), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 3, 16))
+        col.update(preds, target)
+    out = col.compute()
+    assert set(out) == {"acc", "f1"}
+    col.reset()
+    assert col["acc"].update_count == 0
+
+
+def test_custom_dist_sync_fn_extension_point():
+    """The dist_sync_fn hook (reference ``metric.py:105``) lets a host
+    framework replace the sync strategy — e.g. Lightning's strategy object."""
+    calls = {}
+
+    def my_sync(state, reduce_fns, backend):
+        calls["state_keys"] = sorted(state)
+        return state
+
+    m = Accuracy(num_classes=3, validate_args=False, dist_sync_fn=my_sync)
+    rng = np.random.default_rng(2)
+    m.update(jnp.asarray(rng.random((8, 3), dtype=np.float32)), jnp.asarray(rng.integers(0, 3, 8)))
+    m.sync(distributed_available=True)
+    assert "state_keys" in calls
+    m.unsync()
+    m.compute()
