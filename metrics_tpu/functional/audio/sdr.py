@@ -91,7 +91,7 @@ def scale_invariant_signal_distortion_ratio(
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
-        18.4030...
+        18.403
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)

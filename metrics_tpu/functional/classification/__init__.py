@@ -5,7 +5,7 @@ from metrics_tpu.functional.classification.average_precision import average_prec
 from metrics_tpu.functional.classification.calibration_error import calibration_error
 from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa
 from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix
-from metrics_tpu.functional.classification.dice import dice
+from metrics_tpu.functional.classification.dice import dice, dice_score
 from metrics_tpu.functional.classification.f_beta import f1_score, fbeta_score
 from metrics_tpu.functional.classification.hamming import hamming_distance
 from metrics_tpu.functional.classification.hinge import hinge_loss

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utils.checks import _input_format_classification
-from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.data import _bincount, _confusion_counts
 from metrics_tpu.utils.enums import DataType
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -41,14 +41,10 @@ def _confusion_matrix_update(
         target = jnp.argmax(target, axis=1)
     if multilabel:
         unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
-        minlength = 4 * num_classes
-    else:
-        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
-        minlength = num_classes**2
-    bins = _bincount(unique_mapping, minlength=minlength)
-    if multilabel:
+        bins = _bincount(unique_mapping, minlength=4 * num_classes)
         return bins.reshape(num_classes, 2, 2)
-    return bins.reshape(num_classes, num_classes)
+    # MXU one-hot matmul path (falls back to bincount for very large C)
+    return _confusion_counts(preds, target, num_classes)
 
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:

@@ -63,3 +63,47 @@ def dice(
         ignore_index=ignore_index, validate_args=validate_args,
     )
     return _dice_compute(tp, fp, fn, average, mdmc_average, zero_division)
+
+
+def dice_score(
+    preds: Array,
+    target: Array,
+    bg: bool = False,
+    nan_score: float = 0.0,
+    no_fg_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Deprecated alias for :func:`dice` (reference
+    ``functional/classification/dice.py:27-108``; deprecated since v0.9).
+
+    Macro-averaged dice over classes, optionally skipping the background
+    class 0 (``bg=False``).
+    """
+    import math
+
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn(
+        "The `dice_score` function is deprecated. Use the `dice` function instead.",
+        DeprecationWarning,
+    )
+    num_classes = preds.shape[1]
+    if no_fg_score != 0.0:
+        rank_zero_warn("Deprecated parameter. Switched to default `no_fg_score` = 0.0.")
+    if reduction != "elementwise_mean":
+        rank_zero_warn("Deprecated parameter. Switched to default `reduction` = 'elementwise_mean'.")
+    if not math.isfinite(nan_score):
+        nan_score = 0.0
+        rank_zero_warn("Deprecated parameter. Non-finite `nan_score` switched to 0.")
+    zero_division = math.floor(nan_score)
+    if zero_division != nan_score:
+        rank_zero_warn(f"Deprecated parameter. `nan_score` converted to integer {zero_division}.")
+    ignore_index = None if bg else 0
+    return dice(
+        preds,
+        target,
+        ignore_index=ignore_index,
+        average="macro",
+        num_classes=num_classes,
+        zero_division=zero_division,
+    )

@@ -226,7 +226,7 @@ def rouge_score(
         >>> target = "Is your name John"
         >>> scores = rouge_score(preds, target)
         >>> round(float(scores["rouge1_fmeasure"]), 4)
-        0.25
+        0.75
     """
     if accumulate not in ALLOWED_ACCUMULATE_VALUES:
         raise ValueError(

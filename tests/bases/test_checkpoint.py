@@ -1,0 +1,79 @@
+"""Checkpoint/resume semantics (SURVEY.md §5: state_dict protocol +
+orbax-serializable pytrees; reference ``tests/unittests/bases/test_ddp.py:135-241``)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+
+
+def test_state_dict_persistent_roundtrip():
+    m = MeanSquaredError()
+    m.persistent(True)
+    m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.5]))
+    sd = m.state_dict()
+    assert set(sd) == {"sum_squared_error", "total"}
+
+    m2 = MeanSquaredError()
+    m2.load_state_dict(sd)
+    m2._update_count = 1
+    np.testing.assert_allclose(float(m2.compute()), float(m.compute()))
+
+
+def test_mid_epoch_save_and_resume_continues_accumulation():
+    """Save mid-epoch, restore into a fresh instance, keep accumulating —
+    final value equals the uninterrupted run."""
+    batches = [
+        (jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.5])),
+        (jnp.asarray([3.0, 4.0]), jnp.asarray([2.0, 4.5])),
+    ]
+    uninterrupted = MeanSquaredError()
+    for p, t in batches:
+        uninterrupted.update(p, t)
+
+    first = MeanSquaredError()
+    first.update(*batches[0])
+    snapshot = first.state_pytree()
+
+    resumed = MeanSquaredError()
+    resumed.load_state_pytree(dict(snapshot))
+    resumed.update(*batches[1])
+    np.testing.assert_allclose(float(resumed.compute()), float(uninterrupted.compute()))
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")
+
+    m = Accuracy(num_classes=3, validate_args=False)
+    rng = np.random.default_rng(0)
+    m.update(jnp.asarray(rng.random((16, 3), dtype=np.float32)), jnp.asarray(rng.integers(0, 3, 16)))
+    tree = m.state_pytree()
+
+    path = os.path.join(tmp_path, "ckpt")
+    checkpointer = ocp.PyTreeCheckpointer()
+    checkpointer.save(path, tree)
+    restored = checkpointer.restore(path)
+
+    m2 = Accuracy(num_classes=3, validate_args=False)
+    m2._pre_update(jnp.asarray(rng.random((2, 3), dtype=np.float32)), jnp.asarray(rng.integers(0, 3, 2)))
+    m2.load_state_pytree(dict(restored))
+    np.testing.assert_allclose(float(m2.compute()), float(m.compute()))
+
+
+def test_collection_state_roundtrip():
+    # collections hold independent metrics; snapshot each metric's pytree
+    col = MetricCollection({"acc": Accuracy(num_classes=3, validate_args=False)})
+    rng = np.random.default_rng(1)
+    col.update(jnp.asarray(rng.random((8, 3), dtype=np.float32)), jnp.asarray(rng.integers(0, 3, 8)))
+    snaps = {name: m.state_pytree() for name, m in col.items()}
+    col2 = MetricCollection({"acc": Accuracy(num_classes=3, validate_args=False)})
+    for name, m in col2.items():
+        m._pre_update(jnp.asarray(rng.random((2, 3), dtype=np.float32)), jnp.asarray(rng.integers(0, 3, 2)))
+        m.load_state_pytree(dict(snaps[name]))
+        m.sync_on_compute = False
+    np.testing.assert_allclose(
+        float(col2.compute()["acc"]), float(col.compute()["acc"])
+    )

@@ -1,0 +1,35 @@
+"""Docstring examples as tests (the reference enables ``doctest_plus`` so
+every docstring example runs in CI — ``setup.cfg:1-24``)."""
+
+import doctest
+import importlib
+
+import pytest
+
+MODULES = [
+    "metrics_tpu.functional.text.wer",
+    "metrics_tpu.functional.text.cer",
+    "metrics_tpu.functional.text.mer",
+    "metrics_tpu.functional.text.wil",
+    "metrics_tpu.functional.text.wip",
+    "metrics_tpu.functional.text.bleu",
+    "metrics_tpu.functional.text.sacre_bleu",
+    "metrics_tpu.functional.text.chrf",
+    "metrics_tpu.functional.text.ter",
+    "metrics_tpu.functional.text.eed",
+    "metrics_tpu.functional.text.rouge",
+    "metrics_tpu.functional.text.squad",
+    "metrics_tpu.functional.audio.snr",
+    "metrics_tpu.functional.audio.sdr",
+    "metrics_tpu.functional.audio.pit",
+]
+
+
+@pytest.mark.parametrize("module_name", MODULES)
+def test_doctests(module_name):
+    module = importlib.import_module(module_name)
+    results = doctest.testmod(
+        module, optionflags=doctest.ELLIPSIS | doctest.NORMALIZE_WHITESPACE, verbose=False
+    )
+    assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"
+    assert results.attempted > 0, f"no doctests found in {module_name}"
