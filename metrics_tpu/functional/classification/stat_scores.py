@@ -44,6 +44,15 @@ def _stat_scores(
     else:  # samples
         dim = (1,)
 
+    # fused single-pass Pallas kernel for the common macro (N, C) case on TPU;
+    # gated on a one-time compile probe (see stat_scores_fast_path_ok)
+    if reduce == "macro" and preds.ndim == 2 and jax.default_backend() == "tpu":
+        from metrics_tpu.ops import fused_stat_scores
+        from metrics_tpu.ops.stat_scores_pallas import stat_scores_fast_path_ok
+
+        if stat_scores_fast_path_ok():
+            return fused_stat_scores(preds, target)
+
     true_pred = target == preds
     false_pred = target != preds
     pos_pred = preds == 1

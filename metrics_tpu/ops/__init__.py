@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for hot metric ops."""
+
+from metrics_tpu.ops.stat_scores_pallas import fused_stat_scores, pallas_available
+
+__all__ = ["fused_stat_scores", "pallas_available"]

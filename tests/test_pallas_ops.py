@@ -1,0 +1,33 @@
+"""Pallas kernels in interpret mode (CPU rig) vs the jnp reference."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops import fused_stat_scores, pallas_available
+
+
+@pytest.mark.skipif(not pallas_available(), reason="pallas unavailable")
+@pytest.mark.parametrize("n,c", [(512, 8), (1000, 5), (3, 7)])
+def test_fused_stat_scores_interpret(n, c):
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, 2, (n, c)), jnp.int32)
+    target = jnp.asarray(rng.integers(0, 2, (n, c)), jnp.int32)
+    tp, fp, tn, fn = fused_stat_scores(preds, target, interpret=True)
+    p = np.asarray(preds, bool)
+    t = np.asarray(target, bool)
+    np.testing.assert_array_equal(np.asarray(tp), (p & t).sum(0))
+    np.testing.assert_array_equal(np.asarray(fp), (p & ~t).sum(0))
+    np.testing.assert_array_equal(np.asarray(tn), (~p & ~t).sum(0))
+    np.testing.assert_array_equal(np.asarray(fn), (~p & t).sum(0))
+    # counts partition N
+    np.testing.assert_array_equal(
+        np.asarray(tp) + np.asarray(fp) + np.asarray(tn) + np.asarray(fn), np.full(c, n)
+    )
+
+
+@pytest.mark.skipif(not pallas_available(), reason="pallas unavailable")
+def test_fused_stat_scores_empty_input():
+    out = fused_stat_scores(jnp.zeros((0, 4), jnp.int32), jnp.zeros((0, 4), jnp.int32), interpret=True)
+    for arr in out:
+        np.testing.assert_array_equal(np.asarray(arr), np.zeros(4, np.int32))
