@@ -45,12 +45,29 @@ def _stat_scores(
         dim = (1,)
 
     # fused single-pass Pallas kernel for the common macro (N, C) case on TPU;
-    # gated on a one-time compile probe (see stat_scores_fast_path_ok)
+    # gated on a one-time compile probe (see stat_scores_fast_path_ok), a VMEM
+    # class cap, and the operands actually living on the TPU backend
     if reduce == "macro" and preds.ndim == 2 and jax.default_backend() == "tpu":
         from metrics_tpu.ops import fused_stat_scores
-        from metrics_tpu.ops.stat_scores_pallas import stat_scores_fast_path_ok
+        from metrics_tpu.ops.stat_scores_pallas import (
+            MAX_FUSED_CLASSES,
+            stat_scores_fast_path_ok,
+        )
 
-        if stat_scores_fast_path_ok():
+        def _on_default_backend(x: Array) -> bool:
+            if isinstance(x, jax.core.Tracer):
+                return True  # traced under the default (TPU) backend
+            devices = getattr(x, "devices", None)
+            if devices is None:
+                return True
+            return all(d.platform == "tpu" for d in x.devices())
+
+        if (
+            preds.shape[1] <= MAX_FUSED_CLASSES
+            and _on_default_backend(preds)
+            and _on_default_backend(target)
+            and stat_scores_fast_path_ok()
+        ):
             return fused_stat_scores(preds, target)
 
     true_pred = target == preds

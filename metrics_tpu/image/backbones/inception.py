@@ -155,13 +155,16 @@ class InceptionFeatureExtractor:
         feature: str = "2048",
         params: Optional[Dict] = None,
         batch_vars: Optional[Dict] = None,
+        variables: Optional[Dict] = None,
     ) -> None:
         self.feature = str(feature)
         self.model = FlaxInceptionV3()
-        if params is None:
-            rng = jax.random.PRNGKey(0)
-            variables = self.model.init(rng, jnp.zeros((1, 299, 299, 3), jnp.float32))
+        if variables is not None:
+            # full variables tree, e.g. from tools.convert_weights.convert_inception_v3
             self.variables = variables
+        elif params is None:
+            rng = jax.random.PRNGKey(0)
+            self.variables = self.model.init(rng, jnp.zeros((1, 299, 299, 3), jnp.float32))
         else:
             self.variables = {"params": params, **(batch_vars or {})}
         self._jitted = jax.jit(self._forward)

@@ -29,6 +29,9 @@ except Exception:  # pragma: no cover
 Array = jax.Array
 
 _TILE_N = 512
+# the kernel does not tile C; (512, C) int32 blocks for two operands must fit
+# VMEM (~16 MB/core), so cap C and fall back to the jnp path beyond it
+MAX_FUSED_CLASSES = 1024
 
 
 def pallas_available() -> bool:
@@ -101,6 +104,10 @@ def fused_stat_scores(
     if not _PALLAS_OK:
         raise RuntimeError("pallas is unavailable in this jax build")
     n, c = preds.shape
+    if c > MAX_FUSED_CLASSES:
+        raise ValueError(
+            f"fused_stat_scores supports at most {MAX_FUSED_CLASSES} classes (VMEM block limit); got {c}"
+        )
     if n == 0:
         # an empty grid would leave the accumulators uninitialized
         zero = jnp.zeros((c,), jnp.int32)

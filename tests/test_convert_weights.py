@@ -1,0 +1,163 @@
+"""Weight converter: synthetic torch-layout checkpoints -> Flax backbones.
+
+Pretrained files can't be fetched offline, so the tests build state dicts
+with the exact torchvision/lpips key names and shapes and assert the
+converted pytree slots structurally into the Flax modules and changes their
+output (i.e. the weights are actually consumed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from metrics_tpu.image.lpip import _LpipsBackbone
+from tools.convert_weights import (
+    ALEXNET_CONV_INDICES,
+    VGG16_CONV_INDICES,
+    conv_to_flax,
+    convert_lpips_alexnet,
+    convert_lpips_vgg16,
+    flatten_params,
+    linear_to_flax,
+)
+
+VGG16_CHANNELS = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
+VGG16_STAGE_CH = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
+ALEX_CHANNELS = (64, 192, 384, 256, 256)
+LPIPS_HEAD_CH_VGG = (64, 128, 256, 512, 512)
+LPIPS_HEAD_CH_ALEX = (64, 192, 384, 256, 256)
+
+
+def _fake_vgg16_lpips_state_dict(rng):
+    sd = {}
+    in_ch = 3
+    for idx, out_ch in zip(VGG16_CONV_INDICES, VGG16_CHANNELS):
+        sd[f"features.{idx}.weight"] = torch.from_numpy(
+            rng.normal(size=(out_ch, in_ch, 3, 3)).astype(np.float32)
+        )
+        sd[f"features.{idx}.bias"] = torch.from_numpy(rng.normal(size=out_ch).astype(np.float32))
+        in_ch = out_ch
+    for stage, ch in enumerate(LPIPS_HEAD_CH_VGG):
+        sd[f"lin{stage}.model.1.weight"] = torch.from_numpy(
+            rng.random(size=(1, ch, 1, 1)).astype(np.float32)
+        )
+    return sd
+
+
+def _fake_alexnet_lpips_state_dict(rng):
+    sd = {}
+    shapes = [(64, 3, 11, 11), (192, 64, 5, 5), (384, 192, 3, 3), (256, 384, 3, 3), (256, 256, 3, 3)]
+    for idx, shape in zip(ALEXNET_CONV_INDICES, shapes):
+        sd[f"features.{idx}.weight"] = torch.from_numpy(rng.normal(size=shape).astype(np.float32))
+        sd[f"features.{idx}.bias"] = torch.from_numpy(rng.normal(size=shape[0]).astype(np.float32))
+    for stage, ch in enumerate(LPIPS_HEAD_CH_ALEX):
+        sd[f"lin{stage}.weight"] = torch.from_numpy(rng.random(size=(1, ch, 1, 1)).astype(np.float32))
+    return sd
+
+
+def test_layout_transposes():
+    w = np.arange(2 * 3 * 4 * 5).reshape(2, 3, 4, 5).astype(np.float32)  # OIHW
+    f = conv_to_flax(w)
+    assert f.shape == (4, 5, 3, 2)  # HWIO
+    np.testing.assert_array_equal(f[0, 0, :, 0], w[0, :, 0, 0])
+    lw = np.arange(6).reshape(2, 3).astype(np.float32)
+    assert linear_to_flax(lw).shape == (3, 2)
+
+
+@pytest.mark.parametrize(
+    "net_type,maker,converter",
+    [
+        ("vgg", _fake_vgg16_lpips_state_dict, convert_lpips_vgg16),
+        ("alex", _fake_alexnet_lpips_state_dict, convert_lpips_alexnet),
+    ],
+)
+def test_lpips_conversion_slots_into_backbone(net_type, maker, converter):
+    rng = np.random.default_rng(0)
+    sd = maker(rng)
+    params = converter(sd)
+
+    module = _LpipsBackbone(net_type)
+    img = jnp.asarray(rng.normal(size=(1, 64, 64, 3)).astype(np.float32))
+    ref_vars = module.init(jax.random.PRNGKey(0), img, img)
+
+    # structural match: same tree paths, same leaf shapes as a fresh init
+    ref_flat = flatten_params(ref_vars["params"])
+    got_flat = flatten_params(params)
+    assert set(ref_flat) == set(got_flat)
+    for key in ref_flat:
+        assert ref_flat[key].shape == got_flat[key].shape, key
+
+    # converted weights are actually consumed: output differs from random init
+    out_ref = module.apply(ref_vars, img, img + 0.1)
+    out_conv = module.apply({"params": params}, img, img + 0.1)
+    assert np.isfinite(np.asarray(out_conv)).all()
+    assert not np.allclose(np.asarray(out_ref), np.asarray(out_conv))
+
+    # identical images still score zero under converted weights
+    zero = module.apply({"params": params}, img, img)
+    np.testing.assert_allclose(np.asarray(zero), 0.0, atol=1e-6)
+
+
+def test_lpips_metric_accepts_converted_params():
+    from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+
+    rng = np.random.default_rng(1)
+    params = convert_lpips_vgg16(_fake_vgg16_lpips_state_dict(rng))
+    metric = LearnedPerceptualImagePatchSimilarity(net_type="vgg", lpips_params=params)
+    img = np.clip(rng.normal(size=(2, 3, 32, 32)), -1, 1).astype(np.float32)
+    metric.update(img, img)
+    np.testing.assert_allclose(float(metric.compute()), 0.0, atol=1e-6)
+
+
+def test_missing_keys_raise():
+    with pytest.raises(KeyError):
+        convert_lpips_vgg16({"features.0.weight": torch.zeros(64, 3, 3, 3)})
+
+
+def test_inception_conversion_roundtrip():
+    """Build a torch-layout state dict FROM the template topology, convert it
+    back, and check it slots in bit-exact (validates ordering, transposes and
+    batch-stat routing; exact torchvision key names need torchvision)."""
+    from metrics_tpu.image.backbones.inception import FlaxInceptionV3
+    from tools.convert_weights import _walk_convbn_slots, convert_inception_v3
+
+    model = FlaxInceptionV3()
+    template = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 75, 75, 3)))
+    slots = _walk_convbn_slots(template["params"])
+    rng = np.random.default_rng(0)
+    sd = {}
+    for i, path in enumerate(slots):
+        node = template["params"]
+        for p in path:
+            node = node[p]
+        kshape = np.asarray(node["Conv_0"]["kernel"]).shape  # HWIO
+        out_ch = kshape[3]
+        oihw = rng.normal(size=(kshape[3], kshape[2], kshape[0], kshape[1])).astype(np.float32)
+        sd[f"block{i}.conv.weight"] = torch.from_numpy(oihw)
+        sd[f"block{i}.bn.weight"] = torch.from_numpy(rng.normal(size=out_ch).astype(np.float32))
+        sd[f"block{i}.bn.bias"] = torch.from_numpy(rng.normal(size=out_ch).astype(np.float32))
+        sd[f"block{i}.bn.running_mean"] = torch.from_numpy(rng.normal(size=out_ch).astype(np.float32))
+        sd[f"block{i}.bn.running_var"] = torch.from_numpy(rng.random(size=out_ch).astype(np.float32) + 0.5)
+    sd["fc.weight"] = torch.from_numpy(rng.normal(size=(1008, 2048)).astype(np.float32))
+
+    variables = convert_inception_v3(sd, template)
+    # kernels landed where they should, transposed
+    first = slots[0]
+    node = variables["params"]
+    for p in first:
+        node = node[p]
+    np.testing.assert_array_equal(
+        node["Conv_0"]["kernel"],
+        conv_to_flax(sd["block0.conv.weight"].numpy()),
+    )
+    # the converted tree drives the model end to end
+    out = model.apply(variables, jnp.zeros((1, 75, 75, 3)))
+    assert out["2048"].shape == (1, 2048)
+    assert out["logits_unbiased"].shape == (1, 1008)
+
+    # topology mismatch raises
+    sd_short = {k: v for k, v in sd.items() if not k.startswith("block0.")}
+    with pytest.raises(ValueError):
+        convert_inception_v3(sd_short, template)

@@ -1,0 +1,185 @@
+"""Convert published torch checkpoints into metrics_tpu Flax param pytrees.
+
+The reference buys its feature extractors from torch packages
+(``torch-fidelity`` Inception, ``lpips`` VGG/AlexNet — reference
+``image/fid.py:41-58``, ``image/lpip.py:34``); this tool maps those
+checkpoints onto the first-party Flax backbones so scores match published
+numbers.  Conventions handled:
+
+* conv kernels: torch OIHW -> flax HWIO
+* 1x1 LPIPS heads: torch (1, C, 1, 1) -> flax (1, 1, C, 1)
+* linear: torch (out, in) -> flax (in, out)
+* BatchNorm running stats -> the ``batch_stats`` collection
+
+BERTScore needs no converter: HuggingFace's
+``FlaxAutoModel.from_pretrained(..., from_pt=True)`` performs the torch->flax
+conversion natively.
+
+Usage (on a machine with the torch checkpoints available)::
+
+    import torch
+    from tools.convert_weights import convert_lpips_vgg16
+    params = convert_lpips_vgg16(torch.load("lpips_vgg.pth"))
+    np.savez("lpips_vgg_flax.npz", **flatten_params(params))
+"""
+
+from typing import Any, Dict, List, Mapping, Tuple
+
+import numpy as np
+
+# torchvision layer indices of the conv layers inside `features`
+VGG16_CONV_INDICES: Tuple[int, ...] = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+ALEXNET_CONV_INDICES: Tuple[int, ...] = (0, 3, 6, 8, 10)
+
+# our _LpipsBackbone('vgg') names, stage-major (matches VGG16_CONV_INDICES order)
+_VGG_FLAX_NAMES: Tuple[str, ...] = (
+    "stage0_conv0", "stage0_conv1",
+    "stage1_conv0", "stage1_conv1",
+    "stage2_conv0", "stage2_conv1", "stage2_conv2",
+    "stage3_conv0", "stage3_conv1", "stage3_conv2",
+    "stage4_conv0", "stage4_conv1", "stage4_conv2",
+)
+_ALEX_FLAX_NAMES: Tuple[str, ...] = ("conv0", "conv1", "conv2", "conv3", "conv4")
+
+
+def conv_to_flax(weight: np.ndarray) -> np.ndarray:
+    """torch conv kernel OIHW -> flax HWIO."""
+    return np.transpose(np.asarray(weight), (2, 3, 1, 0))
+
+
+def linear_to_flax(weight: np.ndarray) -> np.ndarray:
+    """torch linear (out, in) -> flax (in, out)."""
+    return np.transpose(np.asarray(weight), (1, 0))
+
+
+def _to_numpy(x: Any) -> np.ndarray:
+    # works for torch tensors (via .detach().cpu().numpy()) and arrays
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _lpips_backbone(
+    state_dict: Mapping[str, Any], conv_indices: Tuple[int, ...], flax_names: Tuple[str, ...]
+) -> Dict[str, Any]:
+    """Shared LPIPS conversion: `features.N.{weight,bias}` convs + `linK` heads."""
+    params: Dict[str, Any] = {}
+    for idx, name in zip(conv_indices, flax_names):
+        w = state_dict.get(f"features.{idx}.weight")
+        b = state_dict.get(f"features.{idx}.bias")
+        if w is None or b is None:
+            raise KeyError(f"missing conv weights for features.{idx}")
+        params[name] = {"kernel": conv_to_flax(_to_numpy(w)), "bias": _to_numpy(b)}
+    for stage in range(5):
+        # lpips package naming: lin{K}.model.1.weight; plain: lin{K}.weight
+        for key in (f"lin{stage}.model.1.weight", f"lin{stage}.weight"):
+            if key in state_dict:
+                w = _to_numpy(state_dict[key])  # (1, C, 1, 1)
+                params[f"lin{stage}"] = {"kernel": conv_to_flax(w)}
+                break
+        else:
+            raise KeyError(f"missing LPIPS linear head lin{stage}")
+    return params
+
+
+def convert_lpips_vgg16(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """torchvision VGG16 `features.*` + lpips `lin*` -> _LpipsBackbone('vgg') params."""
+    return _lpips_backbone(state_dict, VGG16_CONV_INDICES, _VGG_FLAX_NAMES)
+
+
+def convert_lpips_alexnet(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """torchvision AlexNet `features.*` + lpips `lin*` -> _LpipsBackbone('alex') params."""
+    return _lpips_backbone(state_dict, ALEXNET_CONV_INDICES, _ALEX_FLAX_NAMES)
+
+
+def _natural_key(name: str) -> Tuple[str, int]:
+    """'_ConvBN_10' -> ('_ConvBN_', 10): numeric-aware module ordering
+    (flax param dicts sort alphabetically, which breaks past index 9)."""
+    i = len(name)
+    while i > 0 and name[i - 1].isdigit():
+        i -= 1
+    return name[:i], int(name[i:]) if i < len(name) else -1
+
+
+def _walk_convbn_slots(tree: Mapping[str, Any], path: Tuple[str, ...] = ()) -> List[Tuple[str, ...]]:
+    """Paths of every Conv+BatchNorm block in module-definition order."""
+    slots: List[Tuple[str, ...]] = []
+    if "Conv_0" in tree and "BatchNorm_0" in tree:
+        slots.append(path)
+        return slots
+    for key in sorted(tree, key=_natural_key):
+        value = tree[key]
+        if isinstance(value, Mapping):
+            slots.extend(_walk_convbn_slots(value, path + (key,)))
+    return slots
+
+
+def _set_path(tree: Dict[str, Any], path: Tuple[str, ...], value: Any) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def convert_inception_v3(
+    state_dict: Mapping[str, Any], template_variables: Mapping[str, Any]
+) -> Dict[str, Any]:
+    """torch Inception-v3 (torchvision / torch-fidelity naming) -> Flax
+    variables for ``FlaxInceptionV3``.
+
+    Both torch models and the Flax module enumerate conv+bn blocks in the
+    same definition order, so conversion walks the template's block slots and
+    fills them from the state dict's ``(.conv.weight, .bn.*)`` groups in
+    insertion order.  Every assignment is shape-checked; a topology mismatch
+    raises instead of silently mis-assigning.
+
+    Args:
+        state_dict: torch state dict (ordered, as ``torch.load`` returns).
+        template_variables: output of ``FlaxInceptionV3().init(...)`` (or the
+            ``variables`` attribute of ``InceptionFeatureExtractor``).
+    """
+    conv_keys = [k for k in state_dict if k.endswith(".conv.weight")]
+    params: Dict[str, Any] = {}
+    batch_stats: Dict[str, Any] = {}
+    slots = _walk_convbn_slots(template_variables["params"])
+    if len(slots) != len(conv_keys):
+        raise ValueError(
+            f"Topology mismatch: template has {len(slots)} conv+bn blocks, "
+            f"checkpoint has {len(conv_keys)}"
+        )
+    tmpl_params = template_variables["params"]
+    for path, conv_key in zip(slots, conv_keys):
+        prefix = conv_key[: -len(".conv.weight")]
+        kernel = conv_to_flax(_to_numpy(state_dict[conv_key]))
+        tmpl_node = tmpl_params
+        for p in path:
+            tmpl_node = tmpl_node[p]
+        want = np.asarray(tmpl_node["Conv_0"]["kernel"]).shape
+        if kernel.shape != want:
+            raise ValueError(f"Shape mismatch at {'/'.join(path)}: {kernel.shape} vs {want}")
+        _set_path(params, path + ("Conv_0",), {"kernel": kernel})
+        _set_path(
+            params, path + ("BatchNorm_0",),
+            {"scale": _to_numpy(state_dict[f"{prefix}.bn.weight"]),
+             "bias": _to_numpy(state_dict[f"{prefix}.bn.bias"])},
+        )
+        _set_path(
+            batch_stats, path + ("BatchNorm_0",),
+            {"mean": _to_numpy(state_dict[f"{prefix}.bn.running_mean"]),
+             "var": _to_numpy(state_dict[f"{prefix}.bn.running_var"])},
+        )
+    if "fc.weight" in state_dict:
+        params["Dense_0"] = {"kernel": linear_to_flax(_to_numpy(state_dict["fc.weight"]))}
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def flatten_params(tree: Mapping[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested params pytree -> flat ``{'a/b/kernel': array}`` dict for npz."""
+    out: Dict[str, np.ndarray] = {}
+    for key, value in tree.items():
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(value, Mapping):
+            out.update(flatten_params(value, path))
+        else:
+            out[path] = np.asarray(value)
+    return out
