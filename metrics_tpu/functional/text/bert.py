@@ -128,9 +128,28 @@ def _model_forward(
     chunks = []
     n = input_ids.shape[0]
     bs = batch_size if batch_size > 0 else n
+    need_hidden = all_layers or num_layers is not None
+    accepts_hidden_kwarg = False
+    if need_hidden:
+        import inspect
+
+        try:
+            sig = inspect.signature(model.__call__)
+            accepts_hidden_kwarg = "output_hidden_states" in sig.parameters or any(
+                p.kind == p.VAR_KEYWORD for p in sig.parameters.values()
+            )
+        except (TypeError, ValueError):
+            accepts_hidden_kwarg = True  # can't introspect; assume HF-style
+        if not accepts_hidden_kwarg:
+            raise ValueError(
+                "`num_layers`/`all_layers` need per-layer hidden states, but the model's "
+                "__call__ does not accept `output_hidden_states`. Use a model exposing "
+                "hidden states or a `user_forward_fn` returning the desired embeddings."
+            )
+    kwargs = {"output_hidden_states": True} if need_hidden else {}
     for s in range(0, n, bs):
         out = model(input_ids=jnp.asarray(input_ids[s : s + bs]),
-                    attention_mask=jnp.asarray(attention_mask[s : s + bs]))
+                    attention_mask=jnp.asarray(attention_mask[s : s + bs]), **kwargs)
         if all_layers:
             emb = jnp.stack(list(out.hidden_states), axis=0)
         elif num_layers is not None and hasattr(out, "hidden_states") and out.hidden_states is not None:

@@ -201,3 +201,24 @@ def reduce(x: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
     if reduction is None or reduction == "none":
         return x
     raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(
+    num: Array, denom: Array, weights: Array, class_reduction: Optional[str] = "none"
+) -> Array:
+    """Reduce per-class ``num / denom`` fractions (reference
+    ``utilities/distributed.py:44-93``): micro / macro / weighted / none,
+    with 0-imputation for empty classes."""
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    if class_reduction == "micro":
+        return jnp.nan_to_num(jnp.sum(num) / jnp.sum(denom))
+    fraction = jnp.nan_to_num(num / denom)
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(jnp.float32) / jnp.sum(weights)))
+    if class_reduction in ("none", None):
+        return fraction
+    raise ValueError(
+        f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}"
+    )

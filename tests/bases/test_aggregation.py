@@ -73,3 +73,60 @@ def test_aggregator_forward():
     batch_val = m(jnp.asarray([4.0]))
     assert float(batch_val) == 4.0
     assert float(m.compute()) == 7.0
+
+
+def test_class_reduce_helper():
+    """micro/macro/weighted/none reduction helper (reference
+    utilities/distributed.py:44-93)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.utils.data import class_reduce
+
+    num = jnp.asarray([3.0, 0.0, 2.0])
+    denom = jnp.asarray([4.0, 0.0, 2.0])
+    w = jnp.asarray([4.0, 0.0, 2.0])
+    np.testing.assert_allclose(float(class_reduce(num, denom, w, "micro")), 5 / 6)
+    np.testing.assert_allclose(np.asarray(class_reduce(num, denom, w, "none")), [0.75, 0.0, 1.0])
+    np.testing.assert_allclose(float(class_reduce(num, denom, w, "macro")), np.mean([0.75, 0, 1.0]))
+    np.testing.assert_allclose(float(class_reduce(num, denom, w, "weighted")), 0.75 * 4 / 6 + 1.0 * 2 / 6)
+    import pytest
+
+    with pytest.raises(ValueError):
+        class_reduce(num, denom, w, "bogus")
+
+
+def test_aux_logits_filtered_in_inception_conversion():
+    """torchvision checkpoints include AuxLogits conv blocks; the converter
+    must skip them rather than fail with a topology mismatch."""
+    import numpy as np
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.image.backbones.inception import FlaxInceptionV3
+    from tools.convert_weights import _walk_convbn_slots, convert_inception_v3
+
+    model = FlaxInceptionV3()
+    template = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 75, 75, 3)))
+    slots = _walk_convbn_slots(template["params"])
+    rng = np.random.default_rng(0)
+    sd = {}
+    for i, path in enumerate(slots):
+        node = template["params"]
+        for p in path:
+            node = node[p]
+        k = np.asarray(node["Conv_0"]["kernel"]).shape
+        sd[f"block{i}.conv.weight"] = torch.from_numpy(rng.normal(size=(k[3], k[2], k[0], k[1])).astype(np.float32))
+        for stat in ("weight", "bias", "running_mean", "running_var"):
+            sd[f"block{i}.bn.{stat}"] = torch.from_numpy(rng.random(size=k[3]).astype(np.float32) + 0.5)
+    # aux head blocks that must be ignored
+    sd["AuxLogits.conv0.conv.weight"] = torch.zeros(128, 768, 1, 1)
+    sd["AuxLogits.conv0.bn.weight"] = torch.zeros(128)
+    sd["AuxLogits.conv0.bn.bias"] = torch.zeros(128)
+    sd["AuxLogits.conv0.bn.running_mean"] = torch.zeros(128)
+    sd["AuxLogits.conv0.bn.running_var"] = torch.ones(128)
+    variables = convert_inception_v3(sd, template)
+    assert "params" in variables and "batch_stats" in variables

@@ -24,10 +24,9 @@ from tools.convert_weights import (
 )
 
 VGG16_CHANNELS = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
-VGG16_STAGE_CH = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
 ALEX_CHANNELS = (64, 192, 384, 256, 256)
 LPIPS_HEAD_CH_VGG = (64, 128, 256, 512, 512)
-LPIPS_HEAD_CH_ALEX = (64, 192, 384, 256, 256)
+LPIPS_HEAD_CH_ALEX = ALEX_CHANNELS
 
 
 def _fake_vgg16_lpips_state_dict(rng):

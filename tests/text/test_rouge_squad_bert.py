@@ -215,3 +215,50 @@ class TestBERTScore(TextTester):
     def test_missing_model_raises(self):
         with pytest.raises(ValueError):
             bert_score(["a"], ["a"])
+
+
+class TestBERTScoreFlaxEncoder:
+    """Exercise the real HF-Flax encoder path (tiny random config, offline)."""
+
+    def _setup(self):
+        transformers = pytest.importorskip("transformers")
+        from transformers import BertConfig, FlaxBertModel
+
+        cfg = BertConfig(
+            vocab_size=97, hidden_size=16, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=32, max_position_embeddings=32,
+        )
+        model = FlaxBertModel(cfg, seed=0)
+
+        class Tok:
+            def __call__(self, texts, padding=None, max_length=16, truncation=True, return_attention_mask=True):
+                ids = [[(hash(w) % 95) + 1 for w in t.split()][:max_length] for t in texts]
+                return {
+                    "input_ids": [i + [0] * (max_length - len(i)) for i in ids],
+                    "attention_mask": [[1] * len(i) + [0] * (max_length - len(i)) for i in ids],
+                }
+
+        return model, Tok()
+
+    def test_hf_model_forward_paths(self):
+        model, tok = self._setup()
+        preds = ["hello there world", "general kenobi"]
+        target = ["hello world", "general grievous"]
+        out = bert_score(preds, target, model=model, user_tokenizer=tok, max_length=16)
+        assert len(out["f1"]) == 2 and all(np.isfinite(out["f1"]))
+        # identical sentences -> f1 == 1
+        same = bert_score(preds, preds, model=model, user_tokenizer=tok, max_length=16)
+        np.testing.assert_allclose(same["f1"], 1.0, atol=1e-5)
+        # hidden-layer selection and all-layers shapes
+        by_layer = bert_score(preds, target, model=model, user_tokenizer=tok, num_layers=1, max_length=16)
+        assert len(by_layer["f1"]) == 2
+        all_l = bert_score(preds, target, model=model, user_tokenizer=tok, all_layers=True, max_length=16)
+        assert np.asarray(all_l["f1"]).shape == (3, 2)  # embeddings + 2 layers
+
+    def test_streaming_class_with_hf_model(self):
+        model, tok = self._setup()
+        metric = BERTScore(model=model, user_tokenizer=tok, max_length=16)
+        metric.update(["a b c"], ["a b d"])
+        metric.update(["x y", "p q r"], ["x z", "p q s"])
+        out = metric.compute()
+        assert len(out["f1"]) == 3

@@ -138,7 +138,12 @@ def convert_inception_v3(
         template_variables: output of ``FlaxInceptionV3().init(...)`` (or the
             ``variables`` attribute of ``InceptionFeatureExtractor``).
     """
-    conv_keys = [k for k in state_dict if k.endswith(".conv.weight")]
+    # torchvision pretrained checkpoints carry an auxiliary classifier head
+    # (AuxLogits.*) absent from the inference-only Flax trunk — skip it
+    conv_keys = [
+        k for k in state_dict
+        if k.endswith(".conv.weight") and not k.startswith("AuxLogits.")
+    ]
     params: Dict[str, Any] = {}
     batch_stats: Dict[str, Any] = {}
     slots = _walk_convbn_slots(template_variables["params"])
