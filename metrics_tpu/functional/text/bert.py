@@ -150,10 +150,15 @@ def _model_forward(
     for s in range(0, n, bs):
         out = model(input_ids=jnp.asarray(input_ids[s : s + bs]),
                     attention_mask=jnp.asarray(attention_mask[s : s + bs]), **kwargs)
-        if all_layers:
-            emb = jnp.stack(list(out.hidden_states), axis=0)
-        elif num_layers is not None and hasattr(out, "hidden_states") and out.hidden_states is not None:
-            emb = out.hidden_states[num_layers]
+        if need_hidden:
+            hidden = getattr(out, "hidden_states", None)
+            if hidden is None:
+                raise ValueError(
+                    "`num_layers`/`all_layers` need per-layer hidden states, but the model "
+                    "returned none despite accepting `output_hidden_states`. Use a model "
+                    "exposing hidden states or a `user_forward_fn`."
+                )
+            emb = jnp.stack(list(hidden), axis=0) if all_layers else hidden[num_layers]
         else:
             emb = out.last_hidden_state
         chunks.append(emb)

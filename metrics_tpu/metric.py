@@ -145,7 +145,10 @@ class Metric(ABC):
                 raise ValueError("list states must default to the empty list")
             value: Any = []
         elif isinstance(default, (jax.Array, np.ndarray, numbers.Number)):
+            # strengthen weak types (python scalars) so the first update does
+            # not retrace once the state becomes a strongly-typed array
             value = jnp.asarray(default)
+            value = value.astype(value.dtype)
             default = value
         else:
             raise ValueError("state default must be an array, a number, or an empty list")

@@ -208,7 +208,13 @@ def class_reduce(
 ) -> Array:
     """Reduce per-class ``num / denom`` fractions (reference
     ``utilities/distributed.py:44-93``): micro / macro / weighted / none,
-    with 0-imputation for empty classes."""
+    with 0-imputation for empty classes.
+
+    Public API-parity helper.  The classification engine itself reduces via
+    ``functional/classification/stat_scores._reduce_stat_scores``, which
+    additionally handles mdmc modes and ignore-index sentinels — change both
+    if the reduction semantics ever move.
+    """
     valid_reduction = ("micro", "macro", "weighted", "none", None)
     if class_reduction == "micro":
         return jnp.nan_to_num(jnp.sum(num) / jnp.sum(denom))
