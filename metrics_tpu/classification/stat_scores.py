@@ -80,8 +80,37 @@ class StatScores(Metric):
         for s in ("tp", "fp", "tn", "fn"):
             self.add_state(s, default=default_factory(), dist_reduce_fx=reduce_fn)
 
+    @staticmethod
+    def _input_fingerprint(preds: Array, target: Array) -> tuple:
+        """Static (value-free) input signature: enough to notice a mode switch
+        like float probs vs int labels without any device->host sync."""
+        return (
+            jnp.issubdtype(preds.dtype, jnp.floating),
+            preds.ndim,
+            preds.shape[1:],
+            jnp.issubdtype(target.dtype, jnp.floating),
+            target.ndim,
+            target.shape[1:],
+        )
+
     def _pre_update(self, preds: Array, target: Array) -> None:
         """Lock the input case on concrete values before the jitted body runs."""
+        from metrics_tpu.utils.enums import DataType
+
+        # once the mode is locked (and the class count resolved where the
+        # pipeline needs one), eager re-detection only re-validates — and each
+        # value inspection is a device->host sync (~100ms over a TPU tunnel).
+        # With validation explicitly disabled, skip it for batches whose
+        # static signature matches the locked one; a dtype/rank change (e.g.
+        # float probs after int labels) still re-runs detection and raises.
+        needs_classes = self.mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+        if (
+            self.mode is not None
+            and not self.validate_args
+            and (self.num_classes is not None or not needs_classes)
+            and getattr(self, "_locked_fingerprint", None) == self._input_fingerprint(preds, target)
+        ):
+            return
         from metrics_tpu.functional.classification.accuracy import _mode
 
         try:
@@ -100,6 +129,7 @@ class StatScores(Metric):
             self.mode = mode
         elif self.mode != mode:
             raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+        self._locked_fingerprint = self._input_fingerprint(preds, target)
         # infer the class count from concrete label values (jit can't), so the
         # traced one-hot canonicalization has a static width
         from metrics_tpu.utils.enums import DataType
