@@ -241,3 +241,29 @@ def test_zero_update_compute_warns():
     m = DummyMetricSum()
     with pytest.warns(UserWarning, match="was called before"):
         m.compute()
+
+
+def test_check_forward_full_state_property(capsys):
+    """The self-profiling utility (reference utilities/checks.py:626-727)
+    runs, prints timings, and validates path agreement."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.utils.checks import check_forward_full_state_property
+
+    rng = np.random.default_rng(0)
+    check_forward_full_state_property(
+        MeanSquaredError,
+        init_args={},
+        input_args={
+            "preds": jnp.asarray(rng.random(16, dtype=np.float32)),
+            "target": jnp.asarray(rng.random(16, dtype=np.float32)),
+        },
+        num_update_to_compare=(5,),
+        reps=1,
+    )
+    out = capsys.readouterr().out
+    assert "full_state_update=true" in out.lower()
+    assert "full_state_update=false" in out.lower()
+    assert "recommended" in out.lower()

@@ -1,0 +1,27 @@
+"""Mechanical API-parity check against the reference export surface.
+
+The lists below are the reference snapshot's `__all__` contents
+(src/torchmetrics/__init__.py and functional/__init__.py), pinned here so
+the check runs without the reference mounted.  Every reference export must
+exist in metrics_tpu under the same name."""
+
+import metrics_tpu
+import metrics_tpu.functional
+
+REFERENCE_TOP_LEVEL = ['AUC', 'AUROC', 'Accuracy', 'AveragePrecision', 'BLEUScore', 'BinnedAveragePrecision', 'BinnedPrecisionRecallCurve', 'BinnedRecallAtFixedPrecision', 'BootStrapper', 'CHRFScore', 'CalibrationError', 'CatMetric', 'CharErrorRate', 'ClasswiseWrapper', 'CohenKappa', 'ConfusionMatrix', 'CosineSimilarity', 'CoverageError', 'Dice', 'ErrorRelativeGlobalDimensionlessSynthesis', 'ExplainedVariance', 'ExtendedEditDistance', 'F1Score', 'FBetaScore', 'HammingDistance', 'HingeLoss', 'JaccardIndex', 'KLDivergence', 'LabelRankingAveragePrecision', 'LabelRankingLoss', 'MatchErrorRate', 'MatthewsCorrCoef', 'MaxMetric', 'MeanAbsoluteError', 'MeanAbsolutePercentageError', 'MeanMetric', 'MeanSquaredError', 'MeanSquaredLogError', 'Metric', 'MetricCollection', 'MetricTracker', 'MinMaxMetric', 'MinMetric', 'MultiScaleStructuralSimilarityIndexMeasure', 'MultioutputWrapper', 'PeakSignalNoiseRatio', 'PearsonCorrCoef', 'PermutationInvariantTraining', 'Precision', 'PrecisionRecallCurve', 'R2Score', 'ROC', 'Recall', 'RetrievalFallOut', 'RetrievalHitRate', 'RetrievalMAP', 'RetrievalMRR', 'RetrievalNormalizedDCG', 'RetrievalPrecision', 'RetrievalPrecisionRecallCurve', 'RetrievalRPrecision', 'RetrievalRecall', 'RetrievalRecallAtFixedPrecision', 'SQuAD', 'SacreBLEUScore', 'ScaleInvariantSignalDistortionRatio', 'ScaleInvariantSignalNoiseRatio', 'SignalDistortionRatio', 'SignalNoiseRatio', 'SpearmanCorrCoef', 'Specificity', 'SpectralAngleMapper', 'SpectralDistortionIndex', 'StatScores', 'StructuralSimilarityIndexMeasure', 'SumMetric', 'SymmetricMeanAbsolutePercentageError', 'TranslationEditRate', 'TweedieDevianceScore', 'UniversalImageQualityIndex', 'WeightedMeanAbsolutePercentageError', 'WordErrorRate', 'WordInfoLost', 'WordInfoPreserved', 'functional']
+
+REFERENCE_FUNCTIONAL = ['accuracy', 'auc', 'auroc', 'average_precision', 'bleu_score', 'calibration_error', 'char_error_rate', 'chrf_score', 'cohen_kappa', 'confusion_matrix', 'cosine_similarity', 'coverage_error', 'dice', 'dice_score', 'error_relative_global_dimensionless_synthesis', 'explained_variance', 'extended_edit_distance', 'f1_score', 'fbeta_score', 'hamming_distance', 'hinge_loss', 'image_gradients', 'jaccard_index', 'kl_divergence', 'label_ranking_average_precision', 'label_ranking_loss', 'match_error_rate', 'matthews_corrcoef', 'mean_absolute_error', 'mean_absolute_percentage_error', 'mean_squared_error', 'mean_squared_log_error', 'multiscale_structural_similarity_index_measure', 'pairwise_cosine_similarity', 'pairwise_euclidean_distance', 'pairwise_linear_similarity', 'pairwise_manhattan_distance', 'peak_signal_noise_ratio', 'pearson_corrcoef', 'permutation_invariant_training', 'pit_permutate', 'precision', 'precision_recall', 'precision_recall_curve', 'r2_score', 'recall', 'retrieval_average_precision', 'retrieval_fall_out', 'retrieval_hit_rate', 'retrieval_normalized_dcg', 'retrieval_precision', 'retrieval_precision_recall_curve', 'retrieval_r_precision', 'retrieval_recall', 'retrieval_reciprocal_rank', 'roc', 'rouge_score', 'sacre_bleu_score', 'scale_invariant_signal_distortion_ratio', 'scale_invariant_signal_noise_ratio', 'signal_distortion_ratio', 'signal_noise_ratio', 'spearman_corrcoef', 'specificity', 'spectral_angle_mapper', 'spectral_distortion_index', 'squad', 'stat_scores', 'structural_similarity_index_measure', 'symmetric_mean_absolute_percentage_error', 'translation_edit_rate', 'tweedie_deviance_score', 'universal_image_quality_index', 'weighted_mean_absolute_percentage_error', 'word_error_rate', 'word_information_lost', 'word_information_preserved']
+
+
+def test_top_level_exports_superset_of_reference():
+    missing = set(REFERENCE_TOP_LEVEL) - set(metrics_tpu.__all__)
+    assert not missing, f"missing reference exports: {sorted(missing)}"
+    for name in REFERENCE_TOP_LEVEL:
+        assert getattr(metrics_tpu, name, None) is not None, name
+
+
+def test_functional_exports_superset_of_reference():
+    missing = set(REFERENCE_FUNCTIONAL) - set(metrics_tpu.functional.__all__)
+    assert not missing, f"missing reference exports: {sorted(missing)}"
+    for name in REFERENCE_FUNCTIONAL:
+        assert getattr(metrics_tpu.functional, name, None) is not None, name
