@@ -5,6 +5,18 @@ the four dimensionalities torch-fidelity exposes (64 / 192 / 768 / 2048),
 so ``feature=<int>`` keeps reference API parity (``image/fid.py:221-232``).
 The whole forward is one jit-compiled XLA program; convolutions run in NHWC
 (TPU-native layout) and inputs are uint8 NCHW images like the reference.
+
+Two topology variants share the same parameter tree (so one converted
+checkpoint serves both):
+
+* ``fid_variant=True`` (default) replicates the TF-graph port the published
+  FID/IS/KID weights were trained under (the checkpoint the reference loads
+  through torch-fidelity, ``image/fid.py:41-58``): average-pool branches
+  exclude padding from the divisor, the final Inception-E block max-pools its
+  pool branch, inputs are resized with the legacy TF1 bilinear kernel and
+  scaled ``(x - 128) / 128``.  Published-score parity requires this variant.
+* ``fid_variant=False`` is the textbook topology (count-include-pad average
+  pools everywhere, half-pixel bilinear resize, ``(x/255 - 0.5) * 2``).
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -17,6 +29,47 @@ import numpy as np
 Array = jax.Array
 
 VALID_FEATURE_DIMS = (64, 192, 768, 2048)
+
+
+def _pool_branch(x: Array, kind: str) -> Array:
+    """3x3 stride-1 SAME pooling for an Inception pool branch.
+
+    ``avg`` includes padded zeros in the divisor; ``avg_excl`` divides by the
+    true window overlap (torch ``count_include_pad=False`` — the TF-port
+    behavior); ``max`` is the TF-port quirk in the final Inception-E block.
+    """
+    if kind == "max":
+        return nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    if kind == "avg_excl":
+        return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME", count_include_pad=False)
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+def tf1_resize_bilinear(x: Array, out_h: int, out_w: int) -> Array:
+    """Legacy TF1 ``resize_bilinear(align_corners=False)`` on NHWC floats.
+
+    Source coordinate is ``dst * (in/out)`` with the origin at the corner (no
+    half-pixel offset) — the kernel the published Inception weights were
+    evaluated under; modern half-pixel resizes shift FID scores measurably.
+    """
+
+    def interp_axis(t: Array, axis: int, in_size: int, out_size: int) -> Array:
+        if in_size == out_size:
+            return t
+        src = jnp.arange(out_size, dtype=jnp.float32) * (in_size / out_size)
+        i0 = jnp.minimum(jnp.floor(src).astype(jnp.int32), in_size - 1)
+        i1 = jnp.minimum(i0 + 1, in_size - 1)
+        frac = src - i0.astype(jnp.float32)
+        shape = [1] * t.ndim
+        shape[axis] = out_size
+        frac = frac.reshape(shape)
+        lo = jnp.take(t, i0, axis=axis)
+        hi = jnp.take(t, i1, axis=axis)
+        return lo * (1.0 - frac) + hi * frac
+
+    x = interp_axis(x, 1, x.shape[1], out_h)
+    x = interp_axis(x, 2, x.shape[2], out_w)
+    return x
 
 
 class _ConvBN(nn.Module):
@@ -34,6 +87,7 @@ class _ConvBN(nn.Module):
 
 class _InceptionA(nn.Module):
     pool_features: int
+    pool_kind: str = "avg"
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -43,7 +97,7 @@ class _InceptionA(nn.Module):
         b3 = _ConvBN(64, (1, 1))(x)
         b3 = _ConvBN(96, (3, 3))(b3)
         b3 = _ConvBN(96, (3, 3))(b3)
-        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = _pool_branch(x, self.pool_kind)
         b4 = _ConvBN(self.pool_features, (1, 1))(b4)
         return jnp.concatenate([b1, b2, b3, b4], axis=-1)
 
@@ -61,6 +115,7 @@ class _InceptionB(nn.Module):
 
 class _InceptionC(nn.Module):
     channels_7x7: int
+    pool_kind: str = "avg"
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -74,7 +129,7 @@ class _InceptionC(nn.Module):
         b3 = _ConvBN(c, (1, 7))(b3)
         b3 = _ConvBN(c, (7, 1))(b3)
         b3 = _ConvBN(192, (1, 7))(b3)
-        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = _pool_branch(x, self.pool_kind)
         b4 = _ConvBN(192, (1, 1))(b4)
         return jnp.concatenate([b1, b2, b3, b4], axis=-1)
 
@@ -93,6 +148,8 @@ class _InceptionD(nn.Module):
 
 
 class _InceptionE(nn.Module):
+    pool_kind: str = "avg"
+
     @nn.compact
     def __call__(self, x: Array) -> Array:
         b1 = _ConvBN(320, (1, 1))(x)
@@ -101,7 +158,7 @@ class _InceptionE(nn.Module):
         b3 = _ConvBN(448, (1, 1))(x)
         b3 = _ConvBN(384, (3, 3))(b3)
         b3 = jnp.concatenate([_ConvBN(384, (1, 3))(b3), _ConvBN(384, (3, 1))(b3)], axis=-1)
-        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = _pool_branch(x, self.pool_kind)
         b4 = _ConvBN(192, (1, 1))(b4)
         return jnp.concatenate([b1, b2, b3, b4], axis=-1)
 
@@ -110,9 +167,12 @@ class FlaxInceptionV3(nn.Module):
     """Inception-v3 trunk with taps at 64/192/768/2048 features + logits."""
 
     num_classes: int = 1008
+    fid_variant: bool = True
 
     @nn.compact
     def __call__(self, x: Array) -> Dict[str, Array]:
+        pool = "avg_excl" if self.fid_variant else "avg"
+        last_pool = "max" if self.fid_variant else "avg"
         taps: Dict[str, Array] = {}
         x = _ConvBN(32, (3, 3), strides=(2, 2), padding="VALID")(x)
         x = _ConvBN(32, (3, 3), padding="VALID")(x)
@@ -123,18 +183,18 @@ class FlaxInceptionV3(nn.Module):
         x = _ConvBN(192, (3, 3), padding="VALID")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         taps["192"] = jnp.mean(x, axis=(1, 2))
-        x = _InceptionA(32)(x)
-        x = _InceptionA(64)(x)
-        x = _InceptionA(64)(x)
+        x = _InceptionA(32, pool_kind=pool)(x)
+        x = _InceptionA(64, pool_kind=pool)(x)
+        x = _InceptionA(64, pool_kind=pool)(x)
         x = _InceptionB()(x)
-        x = _InceptionC(128)(x)
-        x = _InceptionC(160)(x)
-        x = _InceptionC(160)(x)
-        x = _InceptionC(192)(x)
+        x = _InceptionC(128, pool_kind=pool)(x)
+        x = _InceptionC(160, pool_kind=pool)(x)
+        x = _InceptionC(160, pool_kind=pool)(x)
+        x = _InceptionC(192, pool_kind=pool)(x)
         taps["768"] = jnp.mean(x, axis=(1, 2))
         x = _InceptionD()(x)
-        x = _InceptionE()(x)
-        x = _InceptionE()(x)
+        x = _InceptionE(pool_kind=pool)(x)
+        x = _InceptionE(pool_kind=last_pool)(x)
         pooled = jnp.mean(x, axis=(1, 2))
         taps["2048"] = pooled
         taps["logits_unbiased"] = nn.Dense(self.num_classes, use_bias=False)(pooled)
@@ -147,7 +207,7 @@ class InceptionFeatureExtractor:
     Mirrors the reference's ``NoTrainInceptionV3`` contract
     (``image/fid.py:41-58``): input images in [0, 255], internal resize to
     299x299, scaling to [-1, 1].  ``params`` may be a converted pretrained
-    pytree; random init (seeded) otherwise.
+    pytree (see ``tools/fetch_weights.py``); random init (seeded) otherwise.
     """
 
     def __init__(
@@ -156,9 +216,11 @@ class InceptionFeatureExtractor:
         params: Optional[Dict] = None,
         batch_vars: Optional[Dict] = None,
         variables: Optional[Dict] = None,
+        fid_variant: bool = True,
     ) -> None:
         self.feature = str(feature)
-        self.model = FlaxInceptionV3()
+        self.fid_variant = fid_variant
+        self.model = FlaxInceptionV3(fid_variant=fid_variant)
         if variables is not None:
             # full variables tree, e.g. from tools.convert_weights.convert_inception_v3
             self.variables = variables
@@ -170,9 +232,14 @@ class InceptionFeatureExtractor:
         self._jitted = jax.jit(self._forward)
 
     def _forward(self, imgs: Array) -> Array:
-        x = imgs.astype(jnp.float32) / 255.0
-        x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[-1]), method="bilinear")
-        x = (x - 0.5) * 2.0
+        x = imgs.astype(jnp.float32)
+        if self.fid_variant:
+            x = tf1_resize_bilinear(x, 299, 299)
+            x = (x - 128.0) / 128.0
+        else:
+            x = x / 255.0
+            x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[-1]), method="bilinear")
+            x = (x - 0.5) * 2.0
         taps = self.model.apply(self.variables, x)
         return taps[self.feature]
 

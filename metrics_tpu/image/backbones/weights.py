@@ -1,0 +1,99 @@
+"""Converted-checkpoint discovery for the image feature extractors.
+
+The reference gets usable pretrained backbones from torch packages at import
+time (``image/fid.py:41-58`` via torch-fidelity, ``image/lpip.py:23-43`` via
+the lpips package).  This build is torch-free at runtime, so pretrained
+weights arrive as converted ``.npz`` pytrees produced by the one-command
+fetch+convert tool (``python -m tools.fetch_weights --all``, needs network +
+torch once) and are discovered here:
+
+1. ``$METRICS_TPU_WEIGHTS_DIR`` if set,
+2. ``~/.cache/metrics_tpu/weights``,
+3. ``metrics_tpu/_weights/`` inside the package (ship-with-wheel option).
+
+File names: ``inception_fid.npz``, ``lpips_vgg.npz``, ``lpips_alex.npz``.
+When no file is found the extractors fall back to seeded random init and
+warn that scores are not comparable to published numbers.
+"""
+
+import functools
+import os
+from typing import Dict, Optional
+
+INCEPTION_FILE = "inception_fid.npz"
+LPIPS_FILES = {"vgg": "lpips_vgg.npz", "alex": "lpips_alex.npz"}
+
+
+def weight_search_paths(filename: str) -> list:
+    paths = []
+    env = os.environ.get("METRICS_TPU_WEIGHTS_DIR")
+    if env:
+        paths.append(os.path.join(env, filename))
+    paths.append(os.path.join(os.path.expanduser("~"), ".cache", "metrics_tpu", "weights", filename))
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    paths.append(os.path.join(pkg_root, "_weights", filename))
+    return paths
+
+
+def find_weight_file(filename: str) -> Optional[str]:
+    for path in weight_search_paths(filename):
+        if os.path.isfile(path):
+            return path
+    return None
+
+
+def default_weights_dir() -> str:
+    """Where the fetch tool installs converted checkpoints."""
+    env = os.environ.get("METRICS_TPU_WEIGHTS_DIR")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "metrics_tpu", "weights")
+
+
+@functools.lru_cache(maxsize=8)
+def _load_npz_cached(path: str, mtime: float) -> Dict:
+    from metrics_tpu.image.backbones.inception import load_params_npz
+
+    return load_params_npz(path)
+
+
+def load_inception_variables() -> Optional[Dict]:
+    """Converted Inception variables ``{'params':…, 'batch_stats':…}`` if installed.
+
+    Cached per (path, mtime): constructing FID + IS + KID together reads the
+    ~90MB checkpoint once, not three times.
+    """
+    path = find_weight_file(INCEPTION_FILE)
+    if path is None:
+        return None
+    return _load_npz_cached(path, os.path.getmtime(path))
+
+
+def make_inception_extractor(feature: str, params: Optional[Dict] = None):
+    """Build the shared Inception extractor, preferring installed weights.
+
+    Returns ``(extractor, pretrained)``; callers warn when ``pretrained`` is
+    False (random init — scores not comparable to published numbers).
+    """
+    from metrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+
+    if params is not None:
+        # caller-supplied pytree: full variables tree or bare params
+        if "params" in params and isinstance(params.get("params"), dict):
+            return InceptionFeatureExtractor(feature, variables=params), True
+        return InceptionFeatureExtractor(feature, params=params), True
+    variables = load_inception_variables()
+    if variables is not None:
+        return InceptionFeatureExtractor(feature, variables=variables), True
+    return InceptionFeatureExtractor(feature), False
+
+
+def load_lpips_params(net_type: str) -> Optional[Dict]:
+    """Converted LPIPS backbone+head params for ``net_type`` if installed."""
+    filename = LPIPS_FILES.get(net_type)
+    if filename is None:
+        return None
+    path = find_weight_file(filename)
+    if path is None:
+        return None
+    return _load_npz_cached(path, os.path.getmtime(path))

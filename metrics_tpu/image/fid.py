@@ -79,24 +79,23 @@ class FrechetInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            from metrics_tpu.image.backbones.inception import (
-                VALID_FEATURE_DIMS,
-                InceptionFeatureExtractor,
-            )
+            from metrics_tpu.image.backbones.inception import VALID_FEATURE_DIMS
+            from metrics_tpu.image.backbones.weights import make_inception_extractor
 
             if feature not in VALID_FEATURE_DIMS:
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {list(VALID_FEATURE_DIMS)},"
                     f" but got {feature}."
                 )
-            if inception_params is None:
+            self.extractor, pretrained = make_inception_extractor(str(feature), inception_params)
+            if not pretrained:
                 rank_zero_warn(
-                    "Using a randomly initialized Inception-v3: FID values will be architecture-"
-                    "consistent but not comparable to published scores. Pass `inception_params` "
-                    "(converted pretrained weights) for score parity.",
+                    "No converted Inception weights installed: FID values will be architecture-"
+                    "consistent but not comparable to published scores. Run "
+                    "`python -m tools.fetch_weights --inception` once (needs network + torch) "
+                    "or pass `inception_params` for score parity.",
                     UserWarning,
                 )
-            self.extractor: Callable = InceptionFeatureExtractor(str(feature), params=inception_params)
             dim = feature
         elif callable(feature):
             if feature_dim is None:

@@ -33,21 +33,20 @@ class InceptionScore(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, (int, str)):
-            from metrics_tpu.image.backbones.inception import (
-                VALID_FEATURE_DIMS,
-                InceptionFeatureExtractor,
-            )
+            from metrics_tpu.image.backbones.inception import VALID_FEATURE_DIMS
+            from metrics_tpu.image.backbones.weights import make_inception_extractor
 
             valid = ("logits_unbiased",) + tuple(VALID_FEATURE_DIMS)
             if feature not in valid and str(feature) not in map(str, valid):
                 raise ValueError(f"Input to argument `feature` must be one of {list(valid)}, but got {feature}.")
-            if inception_params is None:
+            self.extractor, pretrained = make_inception_extractor(str(feature), inception_params)
+            if not pretrained:
                 rank_zero_warn(
-                    "Using a randomly initialized Inception-v3: scores are not comparable to "
-                    "published numbers. Pass `inception_params` for parity.",
+                    "No converted Inception weights installed: scores are not comparable to "
+                    "published numbers. Run `python -m tools.fetch_weights --inception` once "
+                    "or pass `inception_params` for parity.",
                     UserWarning,
                 )
-            self.extractor: Callable = InceptionFeatureExtractor(str(feature), params=inception_params)
         elif callable(feature):
             self.extractor = feature
         else:
@@ -65,8 +64,11 @@ class InceptionScore(Metric):
         features = features[idx]
         log_prob = jax.nn.log_softmax(features, axis=1)
         prob = jnp.exp(log_prob)
-        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
-        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+        # torch.chunk semantics (reference image/inception.py): fewer samples
+        # than `splits` yields fewer, never-empty chunks — array_split would
+        # emit empty chunks whose mean is NaN
+        prob_chunks = [c for c in jnp.array_split(prob, self.splits, axis=0) if c.shape[0]]
+        log_prob_chunks = [c for c in jnp.array_split(log_prob, self.splits, axis=0) if c.shape[0]]
         kl_ = []
         for p, lp in zip(prob_chunks, log_prob_chunks):
             mean_p = p.mean(axis=0, keepdims=True)

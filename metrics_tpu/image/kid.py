@@ -66,22 +66,21 @@ class KernelInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            from metrics_tpu.image.backbones.inception import (
-                VALID_FEATURE_DIMS,
-                InceptionFeatureExtractor,
-            )
+            from metrics_tpu.image.backbones.inception import VALID_FEATURE_DIMS
+            from metrics_tpu.image.backbones.weights import make_inception_extractor
 
             if feature not in VALID_FEATURE_DIMS:
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {list(VALID_FEATURE_DIMS)}, but got {feature}."
                 )
-            if inception_params is None:
+            self.extractor, pretrained = make_inception_extractor(str(feature), inception_params)
+            if not pretrained:
                 rank_zero_warn(
-                    "Using a randomly initialized Inception-v3: scores are not comparable to "
-                    "published numbers. Pass `inception_params` for parity.",
+                    "No converted Inception weights installed: scores are not comparable to "
+                    "published numbers. Run `python -m tools.fetch_weights --inception` once "
+                    "or pass `inception_params` for parity.",
                     UserWarning,
                 )
-            self.extractor: Callable = InceptionFeatureExtractor(str(feature), params=inception_params)
         elif callable(feature):
             self.extractor = feature
         else:

@@ -111,10 +111,15 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if net is None:
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+            if lpips_params is None and net_type != "squeeze":
+                from metrics_tpu.image.backbones.weights import load_lpips_params
+
+                lpips_params = load_lpips_params(net_type)
             if lpips_params is None:
                 rank_zero_warn(
-                    "Using a randomly initialized LPIPS backbone: scores are not comparable to "
-                    "published numbers. Pass `lpips_params` (converted weights) for parity.",
+                    "No converted LPIPS weights installed: scores are not comparable to "
+                    "published numbers. Run `python -m tools.fetch_weights --lpips` once "
+                    "(needs network + torch) or pass `lpips_params` for parity.",
                     UserWarning,
                 )
             elif net_type == "squeeze":

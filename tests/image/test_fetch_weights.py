@@ -1,0 +1,82 @@
+"""End-to-end test of the fetch+convert+discover pipeline — offline.
+
+Real checkpoints can't be downloaded here, so the pipeline runs against
+torch-saved mirror checkpoints served over ``file://`` URLs: download (with
+sha256 verification against the torch-hub name convention), torch.load,
+convert, install, and automatic discovery by the FID/IS/KID/LPIPS metrics.
+"""
+
+import hashlib
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.image.test_inception_torch_parity import TorchFidInception, _randomize  # noqa: E402
+from tests.image.test_lpips_torch_parity import _fake_state_dict  # noqa: E402
+from tools import fetch_weights  # noqa: E402
+
+
+def _save_hashed(obj, dirpath, stem):
+    tmp = os.path.join(dirpath, "tmp.pth")
+    torch.save(obj, tmp)
+    digest = hashlib.sha256(open(tmp, "rb").read()).hexdigest()
+    final = os.path.join(dirpath, f"{stem}-{digest[:8]}.pth")
+    os.replace(tmp, final)
+    return final
+
+
+def test_hash_prefix_parsing():
+    assert fetch_weights._hash_prefix_from_name("http://x/vgg16-397923af.pth") == "397923af"
+    assert fetch_weights._hash_prefix_from_name("http://x/plain.pth") is None
+
+
+def test_fetch_pipeline_and_discovery(tmp_path, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    inception_pth = _save_hashed(_randomize(TorchFidInception()).state_dict(), str(src), "pt_inception-test")
+    vgg_sd = _fake_state_dict("vgg")
+    backbone = {k: v for k, v in vgg_sd.items() if k.startswith("features.")}
+    heads = {k: v for k, v in vgg_sd.items() if k.startswith("lin")}
+    vgg_pth = _save_hashed(backbone, str(src), "vgg16-test")
+    heads_pth = os.path.join(str(src), "vgg_heads.pth")  # lpips heads carry no hash
+    torch.save(heads, heads_pth)
+
+    out_dir = tmp_path / "weights"
+    cache = tmp_path / "cache"
+    fetch_weights.fetch_inception(str(out_dir), str(cache), url=f"file://{inception_pth}")
+    monkeypatch.setattr(fetch_weights, "VGG16_URL", f"file://{vgg_pth}")
+    monkeypatch.setattr(fetch_weights, "LPIPS_HEADS_URL", {"vgg": f"file://{heads_pth}"})
+    fetch_weights.fetch_lpips(str(out_dir), str(cache), "vgg")
+    assert (out_dir / "inception_fid.npz").is_file()
+    assert (out_dir / "lpips_vgg.npz").is_file()
+
+    # corrupted download must fail the sha check
+    bad = src / "pt_inception-deadbeef.pth"
+    bad.write_bytes(b"junk")
+    with pytest.raises(RuntimeError, match="sha256 mismatch"):
+        fetch_weights.download(f"file://{bad}", str(tmp_path / "cache2"))
+
+    # metrics must now discover the converted weights and drop the warning
+    monkeypatch.setenv("METRICS_TPU_WEIGHTS_DIR", str(out_dir))
+    from metrics_tpu.image.fid import FrechetInceptionDistance
+    from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any "not comparable" warning fails
+        fid = FrechetInceptionDistance(feature=64)
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="vgg")
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(0, 255, size=(2, 3, 32, 32), dtype=np.uint8))
+    fid.update(imgs, real=True)
+    fid.update(jnp.asarray(rng.integers(0, 255, size=(2, 3, 32, 32), dtype=np.uint8)), real=False)
+    assert np.isfinite(float(fid.compute()))
+    a = jnp.asarray(rng.uniform(-1, 1, size=(2, 3, 64, 64)).astype(np.float32))
+    b = jnp.asarray(rng.uniform(-1, 1, size=(2, 3, 64, 64)).astype(np.float32))
+    lpips.update(a, b)
+    assert np.isfinite(float(lpips.compute()))

@@ -147,6 +147,13 @@ class TestInceptionScore:
         np.testing.assert_allclose(float(mean), np.mean(scores), rtol=1e-4)
         np.testing.assert_allclose(float(std), np.std(scores, ddof=1), rtol=1e-3, atol=1e-6)
 
+    def test_fewer_samples_than_splits_is_finite(self):
+        # torch.chunk semantics: never-empty chunks, so small N stays finite
+        m = InceptionScore(feature=_logits_stub, splits=10)
+        m.update(IMGS_A[0][:4])
+        mean, std = m.compute()
+        assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
 
 def _ref_poly_mmd(f_real, f_fake, degree=3, coef=1.0):
     gamma = 1.0 / f_real.shape[1]

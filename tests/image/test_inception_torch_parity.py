@@ -1,0 +1,280 @@
+"""Numerical parity of the Flax Inception (FID variant) against a torch mirror.
+
+Published weights can't be downloaded offline, so conversion correctness is
+proven the other way around: build a torch model with the exact topology and
+state-dict layout of the TF-graph-port checkpoint the reference loads through
+torch-fidelity (reference ``image/fid.py:41-58``), randomize its weights AND
+batch-norm running stats, convert with ``tools.convert_weights``, and demand
+the Flax forward reproduce the torch forward at every feature tap.  Any
+mis-mapped kernel, transposed axis, wrong pooling mode, or skipped BN stat
+makes this fail — so a real fetched checkpoint converts correctly too.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+from torch import nn  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.image.backbones.inception import (  # noqa: E402
+    InceptionFeatureExtractor,
+    tf1_resize_bilinear,
+)
+from tools.convert_weights import convert_inception_v3  # noqa: E402
+
+
+class TConvBN(nn.Module):
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(cout, eps=1e-3)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg_excl(x):
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+class TMixA(nn.Module):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = TConvBN(cin, 64, kernel_size=1)
+        self.branch5x5_1 = TConvBN(cin, 48, kernel_size=1)
+        self.branch5x5_2 = TConvBN(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = TConvBN(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = TConvBN(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = TConvBN(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = TConvBN(cin, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b2 = self.branch5x5_2(self.branch5x5_1(x))
+        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        b4 = self.branch_pool(_avg_excl(x))
+        return torch.cat([b1, b2, b3, b4], 1)
+
+
+class TMixB(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = TConvBN(cin, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = TConvBN(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = TConvBN(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = TConvBN(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b1 = self.branch3x3(x)
+        b2 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        b3 = F.max_pool2d(x, 3, stride=2)
+        return torch.cat([b1, b2, b3], 1)
+
+
+class TMixC(nn.Module):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.branch1x1 = TConvBN(cin, 192, kernel_size=1)
+        self.branch7x7_1 = TConvBN(cin, c7, kernel_size=1)
+        self.branch7x7_2 = TConvBN(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = TConvBN(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = TConvBN(cin, c7, kernel_size=1)
+        self.branch7x7dbl_2 = TConvBN(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = TConvBN(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = TConvBN(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = TConvBN(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = TConvBN(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b2 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        b3 = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+        )
+        b4 = self.branch_pool(_avg_excl(x))
+        return torch.cat([b1, b2, b3, b4], 1)
+
+
+class TMixD(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3_1 = TConvBN(cin, 192, kernel_size=1)
+        self.branch3x3_2 = TConvBN(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = TConvBN(cin, 192, kernel_size=1)
+        self.branch7x7x3_2 = TConvBN(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = TConvBN(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = TConvBN(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b1 = self.branch3x3_2(self.branch3x3_1(x))
+        b2 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        b3 = F.max_pool2d(x, 3, stride=2)
+        return torch.cat([b1, b2, b3], 1)
+
+
+class TMixE(nn.Module):
+    def __init__(self, cin, pool_kind):
+        super().__init__()
+        self.pool_kind = pool_kind
+        self.branch1x1 = TConvBN(cin, 320, kernel_size=1)
+        self.branch3x3_1 = TConvBN(cin, 384, kernel_size=1)
+        self.branch3x3_2a = TConvBN(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = TConvBN(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = TConvBN(cin, 448, kernel_size=1)
+        self.branch3x3dbl_2 = TConvBN(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = TConvBN(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = TConvBN(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = TConvBN(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b2 = self.branch3x3_1(x)
+        b2 = torch.cat([self.branch3x3_2a(b2), self.branch3x3_2b(b2)], 1)
+        b3 = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        b3 = torch.cat([self.branch3x3dbl_3a(b3), self.branch3x3dbl_3b(b3)], 1)
+        if self.pool_kind == "max":
+            pooled = F.max_pool2d(x, 3, stride=1, padding=1)
+        else:
+            pooled = _avg_excl(x)
+        b4 = self.branch_pool(pooled)
+        return torch.cat([b1, b2, b3, b4], 1)
+
+
+class TorchFidInception(nn.Module):
+    """State-dict-compatible mirror of the TF-port FID Inception-v3."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = TConvBN(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = TConvBN(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = TConvBN(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = TConvBN(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = TConvBN(80, 192, kernel_size=3)
+        self.Mixed_5b = TMixA(192, 32)
+        self.Mixed_5c = TMixA(256, 64)
+        self.Mixed_5d = TMixA(288, 64)
+        self.Mixed_6a = TMixB(288)
+        self.Mixed_6b = TMixC(768, 128)
+        self.Mixed_6c = TMixC(768, 160)
+        self.Mixed_6d = TMixC(768, 160)
+        self.Mixed_6e = TMixC(768, 192)
+        # aux head sits between 6e and 7a in the real checkpoints; the
+        # converter must skip it
+        self.AuxLogits = TConvBN(768, 10, kernel_size=1)
+        self.Mixed_7a = TMixD(768)
+        self.Mixed_7b = TMixE(1280, "avg_excl")
+        self.Mixed_7c = TMixE(2048, "max")
+        self.fc = nn.Linear(2048, 1008)
+
+    def forward(self, x):
+        taps = {}
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        taps["64"] = x.mean(dim=(2, 3))
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        taps["192"] = x.mean(dim=(2, 3))
+        x = self.Mixed_5b(x)
+        x = self.Mixed_5c(x)
+        x = self.Mixed_5d(x)
+        x = self.Mixed_6a(x)
+        x = self.Mixed_6b(x)
+        x = self.Mixed_6c(x)
+        x = self.Mixed_6d(x)
+        x = self.Mixed_6e(x)
+        taps["768"] = x.mean(dim=(2, 3))
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = self.Mixed_7c(x)
+        pooled = x.mean(dim=(2, 3))
+        taps["2048"] = pooled
+        taps["logits_unbiased"] = pooled @ self.fc.weight.T
+        return taps
+
+
+def _randomize(model, seed=0):
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for mod in model.modules():
+            if isinstance(mod, nn.BatchNorm2d):
+                mod.running_mean.normal_(0.0, 0.05, generator=g)
+                mod.running_var.uniform_(0.8, 1.2, generator=g)
+                mod.weight.uniform_(0.8, 1.2, generator=g)
+                mod.bias.normal_(0.0, 0.05, generator=g)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def converted():
+    tmodel = _randomize(TorchFidInception())
+    template = InceptionFeatureExtractor("2048").variables
+    variables = convert_inception_v3(tmodel.state_dict(), template)
+    return tmodel, variables
+
+
+def test_all_taps_match_torch(converted):
+    tmodel, variables = converted
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(3, 3, 299, 299), dtype=np.uint8)
+    with torch.no_grad():
+        x = (torch.from_numpy(imgs).float() - 128.0) / 128.0
+        t_taps = tmodel(x)
+    for tap in ("64", "192", "768", "2048", "logits_unbiased"):
+        fx = InceptionFeatureExtractor(tap, variables=variables)
+        got = np.asarray(fx(jnp.asarray(imgs)))
+        want = t_taps[tap].numpy()
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+        # cosine similarity per sample must be essentially 1
+        cos = (got * want).sum(-1) / (
+            np.linalg.norm(got, axis=-1) * np.linalg.norm(want, axis=-1)
+        )
+        assert (cos > 1 - 1e-5).all(), cos
+
+
+def test_tf1_resize_matches_reference_semantics():
+    """tf1_resize_bilinear == legacy TF1 resize (src = dst * in/out, corner origin)."""
+    rng = np.random.default_rng(1)
+    x = rng.random((2, 17, 23, 3)).astype(np.float32)
+
+    def ref_resize(img, oh, ow):
+        n, h, w, c = img.shape
+        out = np.empty((n, oh, ow, c), np.float32)
+        for i in range(oh):
+            fy = i * (h / oh)
+            y0 = min(int(np.floor(fy)), h - 1)
+            y1 = min(y0 + 1, h - 1)
+            wy = fy - y0
+            for j in range(ow):
+                fx = j * (w / ow)
+                x0 = min(int(np.floor(fx)), w - 1)
+                x1 = min(x0 + 1, w - 1)
+                wx = fx - x0
+                top = img[:, y0, x0] * (1 - wx) + img[:, y0, x1] * wx
+                bot = img[:, y1, x0] * (1 - wx) + img[:, y1, x1] * wx
+                out[:, i, j] = top * (1 - wy) + bot * wy
+        return out
+
+    got = np.asarray(tf1_resize_bilinear(jnp.asarray(x), 29, 31))
+    np.testing.assert_allclose(got, ref_resize(x, 29, 31), rtol=1e-5, atol=1e-6)
+    # identity when sizes match
+    np.testing.assert_allclose(
+        np.asarray(tf1_resize_bilinear(jnp.asarray(x), 17, 23)), x, rtol=0, atol=0
+    )
+
+
+def test_aux_logits_skipped_and_topology_checked(converted):
+    tmodel, _ = converted
+    sd = tmodel.state_dict()
+    # dropping a conv must raise the topology mismatch, not silently shift
+    broken = {k: v for k, v in sd.items() if not k.startswith("Mixed_7c.branch_pool")}
+    template = InceptionFeatureExtractor("2048").variables
+    with pytest.raises(ValueError, match="Topology mismatch"):
+        convert_inception_v3(broken, template)

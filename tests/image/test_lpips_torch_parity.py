@@ -1,0 +1,121 @@
+"""Numerical parity of the Flax LPIPS backbones against torch mirrors.
+
+Same strategy as the Inception parity test: mirror the torchvision VGG16 /
+AlexNet feature stacks + lpips linear heads in torch with the exact
+state-dict layout of the published checkpoints (reference ``image/lpip.py:23-43``
+loads these through the lpips package), randomize, convert, and demand the
+Flax LPIPS distance match the torch-computed distance.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+from torch import nn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.image.lpip import _SCALE, _SHIFT, _LpipsBackbone  # noqa: E402
+from tools.convert_weights import (  # noqa: E402
+    ALEXNET_CONV_INDICES,
+    VGG16_CONV_INDICES,
+    convert_lpips_alexnet,
+    convert_lpips_vgg16,
+)
+
+VGG16_CHANNELS = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
+VGG_POOL_AFTER = {1, 3, 6, 9}  # pool after these conv ordinals (not the last stage)
+VGG_TAP_AFTER = {1, 3, 6, 9, 12}
+ALEX_SHAPES = [
+    (64, 3, 11, 11, 4, 2),
+    (192, 64, 5, 5, 1, 2),
+    (384, 192, 3, 3, 1, 1),
+    (256, 384, 3, 3, 1, 1),
+    (256, 256, 3, 3, 1, 1),
+]
+
+
+def _torch_lpips_distance(sd, img0, img1, net_type):
+    """Reference LPIPS forward on a raw state dict (no lpips package)."""
+    shift = torch.tensor(np.asarray(_SHIFT), dtype=torch.float32).view(1, 3, 1, 1)
+    scale = torch.tensor(np.asarray(_SCALE), dtype=torch.float32).view(1, 3, 1, 1)
+    x0 = (img0 - shift) / scale
+    x1 = (img1 - shift) / scale
+    taps = []
+    if net_type == "vgg":
+        for ordinal, idx in enumerate(VGG16_CONV_INDICES):
+            w, b = sd[f"features.{idx}.weight"], sd[f"features.{idx}.bias"]
+            x0 = F.relu(F.conv2d(x0, w, b, padding=1))
+            x1 = F.relu(F.conv2d(x1, w, b, padding=1))
+            if ordinal in VGG_TAP_AFTER:
+                taps.append((x0, x1))
+            if ordinal in VGG_POOL_AFTER:
+                x0 = F.max_pool2d(x0, 2, 2)
+                x1 = F.max_pool2d(x1, 2, 2)
+    else:
+        for i, (cout, cin, kh, kw, stride, pad) in enumerate(ALEX_SHAPES):
+            idx = ALEXNET_CONV_INDICES[i]
+            w, b = sd[f"features.{idx}.weight"], sd[f"features.{idx}.bias"]
+            x0 = F.relu(F.conv2d(x0, w, b, stride=stride, padding=pad))
+            x1 = F.relu(F.conv2d(x1, w, b, stride=stride, padding=pad))
+            taps.append((x0, x1))
+            if i < 2:
+                x0 = F.max_pool2d(x0, 3, 2)
+                x1 = F.max_pool2d(x1, 3, 2)
+    total = torch.zeros(img0.shape[0])
+    for stage, (f0, f1) in enumerate(taps):
+        n0 = f0 / torch.sqrt((f0**2).sum(1, keepdim=True)).clamp_min(1e-10)
+        n1 = f1 / torch.sqrt((f1**2).sum(1, keepdim=True)).clamp_min(1e-10)
+        head = sd.get(f"lin{stage}.model.1.weight", sd.get(f"lin{stage}.weight"))
+        diff = F.conv2d((n0 - n1) ** 2, head)
+        total = total + diff.mean(dim=(2, 3))[:, 0]
+    return total
+
+
+def _fake_state_dict(net_type, seed=0):
+    g = torch.Generator().manual_seed(seed)
+    sd = {}
+    if net_type == "vgg":
+        cin = 3
+        for idx, cout in zip(VGG16_CONV_INDICES, VGG16_CHANNELS):
+            sd[f"features.{idx}.weight"] = torch.empty(cout, cin, 3, 3).normal_(
+                0, (2.0 / (cin * 9)) ** 0.5, generator=g
+            )
+            sd[f"features.{idx}.bias"] = torch.empty(cout).normal_(0, 0.05, generator=g)
+            cin = cout
+        head_ch = (64, 128, 256, 512, 512)
+    else:
+        for i, (cout, cin, kh, kw, _, _) in enumerate(ALEX_SHAPES):
+            idx = ALEXNET_CONV_INDICES[i]
+            sd[f"features.{idx}.weight"] = torch.empty(cout, cin, kh, kw).normal_(
+                0, (2.0 / (cin * kh * kw)) ** 0.5, generator=g
+            )
+            sd[f"features.{idx}.bias"] = torch.empty(cout).normal_(0, 0.05, generator=g)
+        head_ch = (64, 192, 384, 256, 256)
+    for stage, ch in enumerate(head_ch):
+        sd[f"lin{stage}.model.1.weight"] = torch.empty(1, ch, 1, 1).uniform_(0, 1, generator=g)
+    return sd
+
+
+@pytest.mark.parametrize("net_type", ["vgg", "alex"])
+def test_lpips_distance_matches_torch(net_type):
+    sd = _fake_state_dict(net_type)
+    convert = convert_lpips_vgg16 if net_type == "vgg" else convert_lpips_alexnet
+    params = convert(sd)
+    module = _LpipsBackbone(net_type)
+    rng = np.random.default_rng(2)
+    size = 64 if net_type == "vgg" else 96
+    a = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
+    b = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
+    with torch.no_grad():
+        want = _torch_lpips_distance(sd, torch.from_numpy(a), torch.from_numpy(b), net_type).numpy()
+    got = np.asarray(
+        module.apply(
+            {"params": params},
+            jnp.transpose(jnp.asarray(a), (0, 2, 3, 1)),
+            jnp.transpose(jnp.asarray(b), (0, 2, 3, 1)),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

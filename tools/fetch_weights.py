@@ -1,0 +1,162 @@
+"""One-command fetch + convert of the published feature-extractor weights.
+
+The reference's FID/IS/KID load the TF-graph-port Inception checkpoint
+through torch-fidelity (``/root/reference/src/torchmetrics/image/fid.py:41-58``)
+and LPIPS loads pretrained VGG16/AlexNet backbones + learned linear heads
+through the lpips package (``image/lpip.py:23-43``).  This tool downloads the
+same published checkpoint files directly (torch is only needed to deserialize
+them — the torchvision/torch-fidelity/lpips *packages* are not required),
+converts them into Flax pytrees with :mod:`tools.convert_weights`, and
+installs them where :mod:`metrics_tpu.image.backbones.weights` discovers them.
+
+Usage (on a machine with network access)::
+
+    python -m tools.fetch_weights --all          # inception + lpips vgg/alex
+    python -m tools.fetch_weights --inception
+    python -m tools.fetch_weights --lpips
+    METRICS_TPU_WEIGHTS_DIR=/my/dir python -m tools.fetch_weights --all
+
+Integrity: files whose canonical names embed a torch-hub hash prefix
+(``-<8 hex chars>.pth``) are verified against sha256 before conversion; the
+LPIPS head files (no embedded hash) are validated structurally (exact key
+set and shapes).
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+import tempfile
+import urllib.request
+from typing import Dict, Optional
+
+import numpy as np
+
+# TF-graph-port Inception used by FID/IS/KID (pytorch-fid release; the same
+# 2015-12-05 TF dump torch-fidelity ships as weights-inception-2015-12-05-*)
+INCEPTION_URL = (
+    "https://github.com/mseitzer/pytorch-fid/releases/download/"
+    "fid_weights/pt_inception-2015-12-05-6726825d.pth"
+)
+VGG16_URL = "https://download.pytorch.org/models/vgg16-397923af.pth"
+ALEXNET_URL = "https://download.pytorch.org/models/alexnet-owt-7be5be79.pth"
+LPIPS_HEADS_URL = {
+    "vgg": "https://raw.githubusercontent.com/richzhang/PerceptualSimilarity/master/lpips/weights/v0.1/vgg.pth",
+    "alex": "https://raw.githubusercontent.com/richzhang/PerceptualSimilarity/master/lpips/weights/v0.1/alex.pth",
+}
+
+
+def _hash_prefix_from_name(url: str) -> Optional[str]:
+    """torch-hub convention: ``name-<hexdigits>.pth`` -> the hex prefix."""
+    stem = os.path.basename(url).rsplit(".", 1)[0]
+    tail = stem.rsplit("-", 1)[-1]
+    if len(tail) >= 8 and all(c in "0123456789abcdef" for c in tail.lower()):
+        return tail.lower()
+    return None
+
+
+def download(url: str, dest_dir: str) -> str:
+    os.makedirs(dest_dir, exist_ok=True)
+    dest = os.path.join(dest_dir, os.path.basename(url))
+    if not os.path.isfile(dest):
+        print(f"downloading {url}")
+        with urllib.request.urlopen(url) as resp, open(dest + ".part", "wb") as f:
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(dest + ".part", dest)
+    prefix = _hash_prefix_from_name(url)
+    if prefix:
+        digest = hashlib.sha256(open(dest, "rb").read()).hexdigest()
+        if not digest.startswith(prefix):
+            raise RuntimeError(f"sha256 mismatch for {dest}: {digest} !~ {prefix}")
+        print(f"  sha256 ok ({prefix})")
+    return dest
+
+
+def _torch_load(path: str) -> Dict:
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    return obj
+
+
+def fetch_inception(out_dir: str, cache_dir: str, url: str = INCEPTION_URL) -> str:
+    from metrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+    from metrics_tpu.image.backbones.weights import INCEPTION_FILE
+    from tools.convert_weights import convert_inception_v3, flatten_params
+
+    sd = _torch_load(download(url, cache_dir))
+    template = InceptionFeatureExtractor("2048").variables
+    variables = convert_inception_v3(sd, template)
+    out = os.path.join(out_dir, INCEPTION_FILE)
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(out, **flatten_params(variables))
+    print(f"wrote {out}")
+    return out
+
+
+def _validate_lpips_heads(sd: Dict, channels) -> None:
+    for stage, ch in enumerate(channels):
+        keys = [f"lin{stage}.model.1.weight", f"lin{stage}.weight"]
+        found = next((k for k in keys if k in sd), None)
+        if found is None:
+            raise RuntimeError(f"LPIPS heads file is missing lin{stage}")
+        shape = tuple(sd[found].shape)
+        if shape != (1, ch, 1, 1):
+            raise RuntimeError(f"LPIPS head lin{stage} has shape {shape}, expected (1, {ch}, 1, 1)")
+
+
+def fetch_lpips(out_dir: str, cache_dir: str, net_type: str) -> str:
+    from metrics_tpu.image.backbones.weights import LPIPS_FILES
+    from tools.convert_weights import (
+        convert_lpips_alexnet,
+        convert_lpips_vgg16,
+        flatten_params,
+    )
+
+    backbone_url = VGG16_URL if net_type == "vgg" else ALEXNET_URL
+    heads_channels = (64, 128, 256, 512, 512) if net_type == "vgg" else (64, 192, 384, 256, 256)
+    backbone_sd = _torch_load(download(backbone_url, cache_dir))
+    heads_sd = _torch_load(download(LPIPS_HEADS_URL[net_type], cache_dir))
+    _validate_lpips_heads(heads_sd, heads_channels)
+    merged = {**backbone_sd, **heads_sd}
+    convert = convert_lpips_vgg16 if net_type == "vgg" else convert_lpips_alexnet
+    params = convert(merged)
+    out = os.path.join(out_dir, LPIPS_FILES[net_type])
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(out, **flatten_params(params))
+    print(f"wrote {out}")
+    return out
+
+
+def main(argv=None) -> int:
+    from metrics_tpu.image.backbones.weights import default_weights_dir
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--all", action="store_true", help="fetch everything")
+    parser.add_argument("--inception", action="store_true", help="FID/IS/KID Inception-v3")
+    parser.add_argument("--lpips", action="store_true", help="LPIPS vgg + alex")
+    parser.add_argument("--out-dir", default=None, help="install dir (default: discovery path)")
+    parser.add_argument("--cache-dir", default=None, help="raw .pth download cache")
+    parser.add_argument("--inception-url", default=INCEPTION_URL)
+    args = parser.parse_args(argv)
+    if not (args.all or args.inception or args.lpips):
+        parser.error("nothing to do: pass --all, --inception and/or --lpips")
+    out_dir = args.out_dir or default_weights_dir()
+    cache_dir = args.cache_dir or os.path.join(tempfile.gettempdir(), "metrics_tpu_downloads")
+    if args.all or args.inception:
+        fetch_inception(out_dir, cache_dir, url=args.inception_url)
+    if args.all or args.lpips:
+        fetch_lpips(out_dir, cache_dir, "vgg")
+        fetch_lpips(out_dir, cache_dir, "alex")
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
