@@ -25,11 +25,23 @@ class StatScores(Metric):
     with ``sum`` reduction when possible (micro → scalar, macro → ``(C,)``);
     per-sample reductions (``reduce='samples'`` / ``mdmc_reduce='samplewise'``)
     keep ``cat`` list states.
+
+    ``validate_args=False`` contract: per-batch value inspection (a
+    device->host sync) is skipped for batches whose static signature
+    (dtype kind / rank / trailing shape) matches the locked input case.  An
+    input-case switch that changes only *values* — e.g. binary {0,1} int
+    labels followed by wider multiclass int labels of identical rank — is
+    therefore not caught on the switching batch; detection re-runs every
+    ``_REDETECT_EVERY`` skipped batches, so a sustained switch still raises.
+    With ``validate_args=True`` (default) every batch is inspected.
     """
 
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    # with validate_args=False, re-run value-level case detection after this
+    # many fingerprint-matched (skipped) batches
+    _REDETECT_EVERY = 64
 
     def __init__(
         self,
@@ -110,7 +122,11 @@ class StatScores(Metric):
             and (self.num_classes is not None or not needs_classes)
             and getattr(self, "_locked_fingerprint", None) == self._input_fingerprint(preds, target)
         ):
-            return
+            skips = getattr(self, "_fingerprint_skips", 0) + 1
+            if skips < self._REDETECT_EVERY:
+                self._fingerprint_skips = skips
+                return
+            self._fingerprint_skips = 0  # periodic re-detection catches value-only switches
         from metrics_tpu.functional.classification.accuracy import _mode
 
         try:
@@ -128,7 +144,16 @@ class StatScores(Metric):
         if self.mode is None:
             self.mode = mode
         elif self.mode != mode:
-            raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+            # a batch whose VALUES are a subset of the locked case (all labels
+            # <= 1 in a multiclass stream, all-{0,1} ints in a multidim
+            # stream) classifies as the narrower case; that confirms the lock
+            # rather than conflicting with it
+            value_subset_ok = {
+                (DataType.BINARY, DataType.MULTICLASS),
+                (DataType.MULTILABEL, DataType.MULTIDIM_MULTICLASS),
+            }
+            if (mode, self.mode) not in value_subset_ok:
+                raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
         self._locked_fingerprint = self._input_fingerprint(preds, target)
         # infer the class count from concrete label values (jit can't), so the
         # traced one-hot canonicalization has a static width

@@ -65,55 +65,59 @@ class MetricCollection:
         metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
         *additional_metrics: Metric,
     ) -> None:
-        """Add metrics (reference ``collections.py:302-363``)."""
+        """Register metrics into the collection.
+
+        Accepts a single Metric, a sequence of Metrics/MetricCollections
+        (named by class; duplicates rejected), or a mapping name -> Metric
+        (nested collections flattened as ``<name>_<member>``) — the same
+        three input shapes the reference supports (``collections.py:302-363``).
+        """
         if isinstance(metrics, Metric):
             metrics = [metrics]
-        if isinstance(metrics, Sequence):
-            remain: list = []
-            for m in additional_metrics:
-                (metrics if isinstance(m, Metric) else remain).append(m)  # type: ignore[arg-type]
-            if remain:
-                raise ValueError(
-                    f"You have passes extra arguments {remain} which are not Metric instances."
-                )
-        elif additional_metrics:
-            raise ValueError(
-                f"You have passes extra arguments {additional_metrics} which are not compatible"
-                f" with mapping input."
-            )
-
         if isinstance(metrics, dict):
-            for name in sorted(metrics.keys()):
-                metric = metrics[name]
-                if not isinstance(metric, (Metric, MetricCollection)):
-                    raise ValueError(
-                        f"Value {metric} belonging to key {name} is not an instance of"
-                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
-                    )
-                if isinstance(metric, Metric):
-                    self._modules[name] = metric
+            if additional_metrics:
+                raise ValueError(
+                    "Positional metrics cannot be mixed with a mapping input; got "
+                    f"{len(additional_metrics)} extra positional argument(s): {additional_metrics}"
+                )
+            for name in sorted(metrics):
+                entry = metrics[name]
+                if isinstance(entry, Metric):
+                    self._modules[name] = entry
+                elif isinstance(entry, MetricCollection):
+                    for sub_name, sub_metric in entry.items(keep_base=False):
+                        self._modules[f"{name}_{sub_name}"] = sub_metric
                 else:
-                    for k, v in metric.items(keep_base=False):
-                        self._modules[f"{name}_{k}"] = v
+                    raise ValueError(
+                        f"Mapping value under key {name!r} must be a Metric or MetricCollection,"
+                        f" got {type(entry).__name__}: {entry!r}"
+                    )
         elif isinstance(metrics, Sequence):
-            for metric in metrics:
-                if not isinstance(metric, (Metric, MetricCollection)):
-                    raise ValueError(
-                        f"Input {metric} to `MetricCollection` is not a instance of"
-                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
-                    )
-                if isinstance(metric, Metric):
-                    name = type(metric).__name__
+            entries = (*metrics, *additional_metrics)
+            rejected = [e for e in entries if not isinstance(e, (Metric, MetricCollection))]
+            if rejected:
+                raise ValueError(
+                    "Every positional input to MetricCollection must be a Metric or"
+                    f" MetricCollection; rejected: {rejected}"
+                )
+            for entry in entries:
+                pairs = (
+                    [(type(entry).__name__, entry)]
+                    if isinstance(entry, Metric)
+                    else list(entry.items(keep_base=False))
+                )
+                for name, sub_metric in pairs:
                     if name in self._modules:
-                        raise ValueError(f"Encountered two metrics both named {name}")
-                    self._modules[name] = metric
-                else:
-                    for k, v in metric.items(keep_base=False):
-                        if k in self._modules:
-                            raise ValueError(f"Encountered two metrics both named {k}")
-                        self._modules[k] = v
+                        raise ValueError(
+                            f"Metric name {name!r} occurs twice; pass a mapping with"
+                            " distinct keys to disambiguate instances of one class"
+                        )
+                    self._modules[name] = sub_metric
         else:
-            raise ValueError("Unknown input to MetricCollection.")
+            raise ValueError(
+                f"Cannot build a MetricCollection from {type(metrics).__name__}; expected a"
+                " Metric, a sequence of Metrics, or a mapping name -> Metric"
+            )
 
         if isinstance(self._enable_compute_groups, list):
             # explicit groups: validate names, skip auto-detection entirely
@@ -166,27 +170,25 @@ class MetricCollection:
                 self._groups_checked = True
 
     def _merge_compute_groups(self) -> None:
-        """Pairwise-compare metric states; equal states merge into one group
-        (reference ``collections.py:191-249``)."""
-        if not self._compute_groups:
-            self._compute_groups = {i: [name] for i, name in enumerate(self._modules)}
-        n_groups = -1
-        while n_groups != len(self._compute_groups):
-            n_groups = len(self._compute_groups)
-            for cg_idx1, cg_members1 in deepcopy(self._compute_groups).items():
-                for cg_idx2, cg_members2 in deepcopy(self._compute_groups).items():
-                    if cg_idx1 == cg_idx2:
-                        continue
-                    metric1 = self._modules[cg_members1[0]]
-                    metric2 = self._modules[cg_members2[0]]
-                    if self._equal_metric_states(metric1, metric2):
-                        self._compute_groups[cg_idx1].extend(self._compute_groups.pop(cg_idx2))
-                        break
-                else:
-                    continue
-                break
-        # renumber
-        self._compute_groups = {i: g for i, g in enumerate(self._compute_groups.values())}
+        """Group metrics whose post-first-update states are identical.
+
+        Single greedy pass (vs the reference's fixed-point pairwise loop,
+        ``collections.py:191-224``): each metric joins the first group whose
+        leader holds an equal state pytree, else founds its own group.  State
+        equality (same keys, shapes, values) is transitive for this purpose,
+        so one pass reaches the fixed point directly.
+        """
+        groups: List[List[str]] = []
+        for name, metric in self._modules.items():
+            target = next(
+                (g for g in groups if self._equal_metric_states(self._modules[g[0]], metric)),
+                None,
+            )
+            if target is None:
+                groups.append([name])
+            else:
+                target.append(name)
+        self._compute_groups = dict(enumerate(groups))
         self._share_group_states()
 
     @staticmethod

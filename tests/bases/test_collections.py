@@ -30,7 +30,7 @@ def test_metric_collection_dict_and_list():
 
 
 def test_duplicate_names_raise():
-    with pytest.raises(ValueError, match="two metrics both named"):
+    with pytest.raises(ValueError, match="occurs twice"):
         MetricCollection([DummyMetricSum(), DummyMetricSum()])
 
 

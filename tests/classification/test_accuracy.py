@@ -140,3 +140,36 @@ def test_accuracy_invalid_args():
         Accuracy(average="macro")
     with pytest.raises(ValueError, match="top_k"):
         Accuracy(top_k=0)
+
+
+def test_locked_mode_value_switch_caught_periodically():
+    """validate_args=False contract: a values-only input-case switch (same
+    dtype/rank fingerprint) is caught by periodic re-detection, not missed
+    forever (advisor finding, round 1)."""
+    import jax.numpy as jnp
+
+    m = Accuracy(num_classes=4, validate_args=False)
+    m._REDETECT_EVERY = 4
+    binary_preds = jnp.asarray([0, 1, 1, 0])
+    binary_target = jnp.asarray([0, 1, 0, 1])
+    m.update(binary_preds, binary_target)  # locks BINARY mode
+    multiclass_target = jnp.asarray([0, 1, 2, 3])
+    with pytest.raises(ValueError, match="can not use"):
+        for _ in range(2 * m._REDETECT_EVERY):
+            m.update(binary_preds, multiclass_target)
+
+
+def test_locked_mode_value_subset_batch_confirms():
+    """A multiclass stream batch whose labels happen to all be <= 1 must NOT
+    raise a mode conflict when it lands on a re-detection cycle."""
+    import jax.numpy as jnp
+
+    m = Accuracy(num_classes=4, validate_args=False)
+    m._REDETECT_EVERY = 2
+    preds = jnp.asarray([0, 1, 2, 3])
+    target = jnp.asarray([0, 1, 2, 3])
+    m.update(preds, target)  # locks MULTICLASS
+    low = jnp.asarray([0, 1, 1, 0])
+    for _ in range(6):  # crosses multiple re-detection cycles
+        m.update(low, low)
+    assert float(m.compute()) == 1.0
