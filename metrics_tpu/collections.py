@@ -60,6 +60,14 @@ class MetricCollection:
         raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
 
     # ------------------------------------------------------------- population
+    def _register(self, name: str, metric: Metric) -> None:
+        if name in self._modules:
+            raise ValueError(
+                f"Metric name {name!r} occurs twice; use distinct mapping keys"
+                " to disambiguate instances of one class"
+            )
+        self._modules[name] = metric
+
     def add_metrics(
         self,
         metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
@@ -83,10 +91,10 @@ class MetricCollection:
             for name in sorted(metrics):
                 entry = metrics[name]
                 if isinstance(entry, Metric):
-                    self._modules[name] = entry
+                    self._register(name, entry)
                 elif isinstance(entry, MetricCollection):
                     for sub_name, sub_metric in entry.items(keep_base=False):
-                        self._modules[f"{name}_{sub_name}"] = sub_metric
+                        self._register(f"{name}_{sub_name}", sub_metric)
                 else:
                     raise ValueError(
                         f"Mapping value under key {name!r} must be a Metric or MetricCollection,"
@@ -107,12 +115,7 @@ class MetricCollection:
                     else list(entry.items(keep_base=False))
                 )
                 for name, sub_metric in pairs:
-                    if name in self._modules:
-                        raise ValueError(
-                            f"Metric name {name!r} occurs twice; pass a mapping with"
-                            " distinct keys to disambiguate instances of one class"
-                        )
-                    self._modules[name] = sub_metric
+                    self._register(name, sub_metric)
         else:
             raise ValueError(
                 f"Cannot build a MetricCollection from {type(metrics).__name__}; expected a"

@@ -171,24 +171,25 @@ class MultihostBackend(Backend):
         return jax.process_count()
 
     def _gather(self, x: Array) -> Array:
+        """Stacked cross-process gather: returns ``(P,) + x.shape``."""
         from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(x)
+        return multihost_utils.process_allgather(jnp.asarray(x))
 
     def psum(self, x):
-        return jnp.sum(self._gather(jnp.asarray(x)[None]), axis=0)
+        return jnp.sum(self._gather(x), axis=0)
 
     def pmean(self, x):
-        return jnp.mean(self._gather(jnp.asarray(x)[None]), axis=0)
+        return jnp.mean(self._gather(x), axis=0)
 
     def pmax(self, x):
-        return jnp.max(self._gather(jnp.asarray(x)[None]), axis=0)
+        return jnp.max(self._gather(x), axis=0)
 
     def pmin(self, x):
-        return jnp.min(self._gather(jnp.asarray(x)[None]), axis=0)
+        return jnp.min(self._gather(x), axis=0)
 
     def all_gather_stack(self, x):
-        return self._gather(jnp.asarray(x)[None])
+        return self._gather(x)
 
     def all_gather_cat(self, x):
         """Uneven-shape-safe gather: sizes → pad-to-max → gather → trim.
@@ -196,18 +197,14 @@ class MultihostBackend(Backend):
         Direct analog of reference ``utilities/distributed.py:128-151``.
         """
         x = jnp.atleast_1d(jnp.asarray(x))
-        local_size = x.shape[0]
-        sizes = self._gather(jnp.asarray([local_size]))  # (P, 1)
-        sizes = [int(s) for s in sizes.reshape(-1)]
+        sizes = [int(s) for s in self._gather(x.shape[0])]  # (P,)
         max_size = max(sizes)
         if all(s == max_size for s in sizes):
-            gathered = self._gather(x[None])  # (P, n, ...)
-            return gathered.reshape((-1,) + x.shape[1:])
-        pad = [(0, max_size - local_size)] + [(0, 0)] * (x.ndim - 1)
-        padded = jnp.pad(x, pad)
-        gathered = self._gather(padded[None])  # (P, max, ...)
-        parts = [gathered[p, : sizes[p]] for p in range(len(sizes))]
-        return jnp.concatenate(parts, axis=0)
+            gathered = self._gather(x)  # (P, n, ...)
+            return gathered.reshape((-1,) + tuple(x.shape[1:]))
+        pad = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        gathered = self._gather(jnp.pad(x, pad))  # (P, max, ...)
+        return jnp.concatenate([gathered[p, : sizes[p]] for p in range(len(sizes))], axis=0)
 
 
 def get_backend(axis_name: Optional[Union[str, Sequence[str]]] = None) -> Backend:

@@ -1,0 +1,68 @@
+"""Worker process for the real two-process DCN sync test.
+
+Launched by ``tests/bases/test_ddp.py::test_multihost_two_process_real`` as
+``python _dcn_worker.py <rank> <nproc> <port>``.  Initializes
+``jax.distributed`` (CPU, gloo-backed collectives over localhost — the TPU
+translation of the reference's spawned gloo process groups,
+``tests/unittests/bases/test_ddp.py:63-81``) and runs metric sync end-to-end
+through ``Metric.compute()`` on the MultihostBackend, including the
+uneven-shard gather-sizes → pad → gather → trim path.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=rank
+    )
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu.aggregation import CatMetric
+    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.parallel.backend import MultihostBackend, get_backend
+
+    assert jax.process_count() == nproc
+    assert isinstance(get_backend(), MultihostBackend)
+
+    # ---- uneven-shard cat list state through Metric.compute()
+    sizes = [r + 3 for r in range(nproc)]  # rank r holds r+3 elements
+    shards = [np.arange(s, dtype=np.float32) + 100.0 * r for r, s in enumerate(sizes)]
+    cat = CatMetric(nan_strategy="ignore")
+    cat.update(jnp.asarray(shards[rank]))
+    np.testing.assert_allclose(np.asarray(cat.compute()), np.concatenate(shards))
+    # unsync must have restored the local shard afterwards
+    assert not cat._is_synced
+    np.testing.assert_allclose(np.asarray(cat.value[0]), shards[rank])
+
+    # ---- sum-state metric: every rank must hold the all-data accuracy
+    def batch(r: int):
+        rng = np.random.default_rng(1000 + r)
+        return rng.integers(0, 4, 32), rng.integers(0, 4, 32)
+
+    acc = Accuracy(num_classes=4, validate_args=False)
+    preds, target = batch(rank)
+    acc.update(jnp.asarray(preds), jnp.asarray(target))
+    got = float(acc.compute())
+    all_preds = np.concatenate([batch(r)[0] for r in range(nproc)])
+    all_target = np.concatenate([batch(r)[1] for r in range(nproc)])
+    want = float((all_preds == all_target).mean())
+    assert abs(got - want) < 1e-6, (got, want)
+    # local state restored after sync: local-only value differs in general
+    local_acc = float((preds == target).mean())
+    acc.sync_on_compute = False
+    acc._computed = None
+    assert abs(float(acc.compute()) - local_acc) < 1e-6
+
+    print(f"DCN_WORKER_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

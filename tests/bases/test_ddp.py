@@ -85,29 +85,77 @@ def test_axis_context_routing():
     assert current_axis() is None
 
 
-def test_multihost_uneven_gather_simulated():
-    """Uneven-shape pad→gather→trim (reference test_ddp uneven gather 63-81)."""
+def test_multihost_two_process_real():
+    """Real spawned 2-process DCN sync through Metric.compute().
+
+    TPU translation of the reference's gloo process-group tests
+    (``tests/unittests/bases/test_ddp.py:63-81``): two ``jax.distributed``
+    CPU processes, uneven cat-state gather + sum-state reduction, symmetric
+    results, unsync-restores-local-state — all exercised in
+    ``tests/bases/_dcn_worker.py``.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    sock = socket.socket()
+    sock.bind(("localhost", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_dcn_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(worker))))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers need plain 1-device CPU platforms
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    nproc = 2
+    from concurrent.futures import ThreadPoolExecutor
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), str(nproc), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(nproc)
+    ]
+    try:
+        # drain both pipes concurrently: a worker blocking on a full stdout
+        # pipe mid-collective would deadlock the other rank too
+        with ThreadPoolExecutor(nproc) as pool:
+            outs = [f.result() for f in [pool.submit(p.communicate, timeout=300) for p in procs]]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for r, (p, (out, _)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_WORKER_OK rank={r}" in out
+
+
+def test_multihost_uneven_gather_unit():
+    """Unit test of the pad→gather→trim scheme against a faked stacked gather
+    honoring the real ``process_allgather`` contract ``(P,) + x.shape``
+    (the end-to-end two-process version runs above)."""
     shards = [jnp.arange(3, dtype=jnp.float32), jnp.arange(3, 5, dtype=jnp.float32)]
 
     class FakeMultihost(MultihostBackend):
-        def __init__(self, rank):
-            self.rank = rank
-
         def _gather(self, x):
-            # emulate two processes: pad each local shard like each rank would
+            x = jnp.asarray(x)
+            if x.ndim == 0:  # the size gather
+                return jnp.asarray([s.shape[0] for s in shards])
+            # each rank contributes its shard padded to the caller's shape
             outs = []
             for shard in shards:
-                local = jnp.atleast_1d(shard)
-                if x.shape[1:] and x.shape[1] >= local.shape[0]:
-                    pad = [(0, x.shape[1] - local.shape[0])] + [(0, 0)] * (local.ndim - 1)
-                    local = jnp.pad(local, pad)
-                outs.append(local[None] if local.shape != x.shape[1:] else local[None])
-            # emulate size-gather (x is (1,) of local size) or payload gather
-            if x.shape == (1, 1) or x.shape == (1,):
-                return jnp.stack([jnp.asarray([s.shape[0]]) for s in shards])
-            return jnp.concatenate(outs, axis=0)
+                pad = [(0, x.shape[0] - shard.shape[0])] + [(0, 0)] * (shard.ndim - 1)
+                outs.append(jnp.pad(shard, pad))
+            return jnp.stack(outs)
 
-    b = FakeMultihost(0)
+    b = FakeMultihost()
     out = b.all_gather_cat(shards[0])
     np.testing.assert_allclose(np.asarray(out), [0.0, 1.0, 2.0, 3.0, 4.0])
 
