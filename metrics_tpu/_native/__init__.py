@@ -75,6 +75,11 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
         ]
+        lib.mtpu_lap_batch.restype = None
+        lib.mtpu_lap_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.mtpu_coco_match.restype = None
         lib.mtpu_coco_match.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
@@ -302,3 +307,86 @@ def rle_iou(a: np.ndarray, b: np.ndarray, iscrowd_b: bool = False) -> float:
     area_a, area_b = rle_area(a), rle_area(b)
     denom = area_a if iscrowd_b else (area_a + area_b - inter)
     return inter / denom if denom > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Linear assignment (Jonker-Volgenant shortest augmenting paths)
+# ---------------------------------------------------------------------------
+def _lap_py(cost: np.ndarray) -> np.ndarray:
+    """Pure-Python JV fallback: min-cost assignment of one (n, n) matrix.
+
+    Same algorithm as the native ``mtpu_lap_batch`` kernel: dual potentials
+    u/v plus shortest augmenting paths, O(n^3).
+    """
+    n = cost.shape[0]
+    INF = float("inf")
+    u = [0.0] * (n + 1)
+    v = [0.0] * (n + 1)
+    p = [0] * (n + 1)
+    way = [0] * (n + 1)
+    for i in range(1, n + 1):
+        p[0] = i
+        j0 = 0
+        minv = [INF] * (n + 1)
+        used = [False] * (n + 1)
+        while True:
+            used[j0] = True
+            i0, j1, delta = p[j0], 0, INF
+            for j in range(1, n + 1):
+                if used[j]:
+                    continue
+                cur = cost[i0 - 1, j - 1] - u[i0] - v[j]
+                if cur < minv[j]:
+                    minv[j] = cur
+                    way[j] = j0
+                if minv[j] < delta:
+                    delta = minv[j]
+                    j1 = j
+            for j in range(n + 1):
+                if used[j]:
+                    u[p[j]] += delta
+                    v[j] -= delta
+                else:
+                    minv[j] -= delta
+            j0 = j1
+            if p[j0] == 0:
+                break
+        while j0:
+            j1 = way[j0]
+            p[j0] = p[j1]
+            j0 = j1
+    out = np.empty(n, dtype=np.int64)
+    for j in range(1, n + 1):
+        if p[j]:
+            out[p[j] - 1] = j - 1
+    return out
+
+
+def lap_batch(cost: np.ndarray) -> np.ndarray:
+    """Min-cost linear assignment for a batch of square matrices.
+
+    Args: cost (batch, n, n) — ``out[b, i]`` is the column assigned to row i.
+    The device path enumerates permutations for small n (audio PIT); this is
+    the host path for large n, replacing the reference's scipy
+    ``linear_sum_assignment`` dependency (``functional/audio/pit.py:28-49``).
+    """
+    cost = np.ascontiguousarray(cost, dtype=np.float64)
+    if cost.ndim != 3 or cost.shape[1] != cost.shape[2]:
+        raise ValueError(f"lap_batch expects (batch, n, n), got {cost.shape}")
+    if not np.isfinite(cost).all():
+        # NaN would make every dual comparison false and hang the
+        # augmenting-path loop (scipy raises on this input too)
+        raise ValueError("lap_batch: cost matrix contains non-finite entries")
+    batch, n = cost.shape[0], cost.shape[1]
+    if n == 0 or batch == 0:
+        return np.zeros((batch, n), dtype=np.int64)
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty((batch, n), dtype=np.int64)
+        lib.mtpu_lap_batch(
+            cost.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            batch, n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out
+    return np.stack([_lap_py(cost[b]) for b in range(batch)])

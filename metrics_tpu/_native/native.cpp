@@ -138,4 +138,49 @@ void mtpu_coco_match(const double* ious, int64_t n_det, int64_t n_gt,
     }
 }
 
+// Batched minimum-cost linear assignment (Jonker-Volgenant style shortest
+// augmenting paths with dual potentials, O(n^3) per matrix).  The audio PIT
+// metric routes large speaker counts here instead of enumerating n!
+// permutations (the reference delegates this regime to scipy's
+// linear_sum_assignment, functional/audio/pit.py:28-49).
+// cost: (batch, n, n) row-major; out_assign[b*n + i] = column chosen for row i.
+void mtpu_lap_batch(const double* cost, int64_t batch, int64_t n, int64_t* out_assign) {
+    const double INF = 1e300;
+    std::vector<double> u(n + 1), v(n + 1), minv(n + 1);
+    std::vector<int64_t> p(n + 1), way(n + 1);
+    std::vector<uint8_t> used(n + 1);
+    for (int64_t b = 0; b < batch; ++b) {
+        const double* a = cost + b * n * n;
+        std::fill(u.begin(), u.end(), 0.0);
+        std::fill(v.begin(), v.end(), 0.0);
+        std::fill(p.begin(), p.end(), 0);
+        for (int64_t i = 1; i <= n; ++i) {
+            p[0] = i;
+            int64_t j0 = 0;
+            std::fill(minv.begin(), minv.end(), INF);
+            std::fill(used.begin(), used.end(), 0);
+            do {
+                used[j0] = 1;
+                const int64_t i0 = p[j0];
+                int64_t j1 = 0;
+                double delta = INF;
+                for (int64_t j = 1; j <= n; ++j) {
+                    if (used[j]) continue;
+                    const double cur = a[(i0 - 1) * n + (j - 1)] - u[i0] - v[j];
+                    if (cur < minv[j]) { minv[j] = cur; way[j] = j0; }
+                    if (minv[j] < delta) { delta = minv[j]; j1 = j; }
+                }
+                for (int64_t j = 0; j <= n; ++j) {
+                    if (used[j]) { u[p[j]] += delta; v[j] -= delta; }
+                    else minv[j] -= delta;
+                }
+                j0 = j1;
+            } while (p[j0] != 0);
+            do { const int64_t j1 = way[j0]; p[j0] = p[j1]; j0 = j1; } while (j0);
+        }
+        for (int64_t j = 1; j <= n; ++j)
+            if (p[j]) out_assign[b * n + (p[j] - 1)] = j - 1;
+    }
+}
+
 }  // extern "C"

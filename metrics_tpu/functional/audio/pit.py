@@ -1,10 +1,16 @@
 """Permutation invariant training (reference ``functional/audio/pit.py``).
 
 TPU-first redesign: the metric matrix is built with a double ``vmap`` over
-(pred-speaker, target-speaker) pairs and the permutation search is a gather +
-argmax over the precomputed permutation table — the whole thing traces into a
-single XLA program (the reference's scipy Hungarian path is host-side; with
-typical speaker counts ≤ 6 the exhaustive table is small and device-friendly).
+(pred-speaker, target-speaker) pairs.  The permutation search has two tiers:
+
+* ``spk <= 6`` (or any traced call up to 8): gather + argmax over the
+  precomputed permutation table — the whole metric traces into a single XLA
+  program, no host round-trip.
+* larger speaker counts on concrete values: a first-party batched
+  Jonker-Volgenant assignment solver on host (``metrics_tpu._native``,
+  C++ with a Python fallback) — the analog of the reference's scipy
+  ``linear_sum_assignment`` path (``functional/audio/pit.py:28-49``) without
+  the scipy dependency, exact and O(spk^3) instead of O(spk!).
 """
 
 from itertools import permutations
@@ -18,6 +24,11 @@ Array = jax.Array
 
 # permutation tables are tiny and reused every call
 _PERM_CACHE: dict = {}
+
+# device exhaustive search up to here on concrete calls (720 perms); traced
+# calls may go to 8 (40320 perms) since the host LAP needs concrete values
+_EXHAUSTIVE_SPK_LIMIT = 6
+_TRACED_SPK_LIMIT = 8
 
 
 def _perm_table(spk_num: int) -> np.ndarray:
@@ -73,6 +84,14 @@ def permutation_invariant_training(
     metric_mtx = jax.vmap(lambda i: jax.vmap(lambda j: pair_metric(i, j))(idx))(idx)
     metric_mtx = jnp.moveaxis(metric_mtx, -1, 0)  # [batch, spk, spk]
 
+    traced = isinstance(metric_mtx, jax.core.Tracer)
+    if spk_num > _EXHAUSTIVE_SPK_LIMIT and (not traced or spk_num > _TRACED_SPK_LIMIT):
+        # host assignment solver; on a tracer (only possible past the traced
+        # limit) _pit_lap's np.asarray raises TracerArrayConversionError,
+        # which the Metric runtime catches to re-run the update eagerly —
+        # direct functional callers must stay outside jit at that scale
+        return _pit_lap(metric_mtx, eval_func)
+
     perms = jnp.asarray(_perm_table(spk_num))  # [perm_num, spk]
     # score of permutation p: mean over target speakers s of
     # mtx[b, perms[p, s], s] — i.e. prediction perms[p, s] serves target s,
@@ -91,6 +110,22 @@ def permutation_invariant_training(
         best_metric = jnp.min(scores, axis=1)
     best_perm = perms[best_idx]
     return best_metric, best_perm
+
+
+def _pit_lap(metric_mtx: Array, eval_func: str) -> Tuple[Array, Array]:
+    """Exact assignment via the batched JV solver (host, O(spk^3))."""
+    from metrics_tpu._native import lap_batch
+
+    mtx = np.asarray(metric_mtx)  # [batch, pred_spk, target_spk]
+    # rows = target speakers, cols = prediction speakers, so the solution
+    # maps target index -> prediction index (the pit_permutate contract)
+    cost = np.ascontiguousarray(np.swapaxes(mtx, 1, 2), dtype=np.float64)
+    if eval_func == "max":
+        cost = -cost
+    assign = lap_batch(cost)  # [batch, spk]
+    picked = np.take_along_axis(np.swapaxes(mtx, 1, 2), assign[:, :, None], axis=2)[..., 0]
+    best_metric = picked.mean(axis=-1)
+    return jnp.asarray(best_metric, dtype=metric_mtx.dtype), jnp.asarray(assign, dtype=jnp.int32)
 
 
 def pit_permutate(preds: Array, perm: Array) -> Array:

@@ -2,6 +2,7 @@
 
 from itertools import permutations
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 import scipy.linalg
@@ -216,3 +217,97 @@ def test_pesq_stoi_gated():
     if not _PYSTOI_AVAILABLE:
         with pytest.raises(ModuleNotFoundError):
             short_time_objective_intelligibility(PREDS[0], TARGET[0], 16000)
+
+
+class TestLapPit:
+    """Large-speaker PIT via the first-party JV assignment solver."""
+
+    def test_lap_batch_matches_scipy(self):
+        from scipy.optimize import linear_sum_assignment
+
+        from metrics_tpu._native import _lap_py, lap_batch
+
+        rng = np.random.default_rng(7)
+        cost = rng.normal(size=(6, 12, 12))
+        got = lap_batch(cost)
+        for b in range(cost.shape[0]):
+            rows, cols = linear_sum_assignment(cost[b])
+            sp = cost[b][rows, cols].sum()
+            ours = cost[b][np.arange(12), got[b]].sum()
+            np.testing.assert_allclose(ours, sp, rtol=1e-12)
+            # Python fallback implements the identical algorithm
+            py = _lap_py(cost[b])
+            np.testing.assert_allclose(cost[b][np.arange(12), py].sum(), sp, rtol=1e-12)
+
+    @pytest.mark.parametrize("eval_func", ["max", "min"])
+    def test_lap_path_agrees_with_exhaustive(self, eval_func):
+        """At the boundary (spk=6 exhaustive vs forced LAP) both tiers agree."""
+        from metrics_tpu.functional.audio import pit as pit_mod
+
+        rng = np.random.default_rng(3)
+        preds = jnp.asarray(rng.normal(size=(3, 6, 50)), jnp.float32)
+        target = jnp.asarray(rng.normal(size=(3, 6, 50)), jnp.float32)
+        best_ex, perm_ex = permutation_invariant_training(
+            preds, target, scale_invariant_signal_distortion_ratio, eval_func
+        )
+        old = pit_mod._EXHAUSTIVE_SPK_LIMIT
+        pit_mod._EXHAUSTIVE_SPK_LIMIT = 5  # force the LAP tier at spk=6
+        try:
+            best_lap, perm_lap = permutation_invariant_training(
+                preds, target, scale_invariant_signal_distortion_ratio, eval_func
+            )
+        finally:
+            pit_mod._EXHAUSTIVE_SPK_LIMIT = old
+        np.testing.assert_allclose(np.asarray(best_ex), np.asarray(best_lap), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(perm_ex), np.asarray(perm_lap))
+
+    def test_ten_speakers(self):
+        """spk=10 (10! = 3.6M perms — infeasible exhaustively) solves exactly
+        and fast via LAP; optimality cross-checked against scipy."""
+        from scipy.optimize import linear_sum_assignment
+
+        rng = np.random.default_rng(11)
+        spk = 10
+        preds = jnp.asarray(rng.normal(size=(4, spk, 80)), jnp.float32)
+        target = jnp.asarray(rng.normal(size=(4, spk, 80)), jnp.float32)
+        best, perm = permutation_invariant_training(
+            preds, target, scale_invariant_signal_distortion_ratio, "max"
+        )
+        assert perm.shape == (4, spk)
+        # every row of perm is a permutation
+        for row in np.asarray(perm):
+            assert sorted(row.tolist()) == list(range(spk))
+        # cross-check optimality on the raw metric matrix
+        mtx = np.stack([
+            np.stack([
+                np.asarray(_ref_si_sdr(np.asarray(preds[:, i]), np.asarray(target[:, j])))
+                for j in range(spk)
+            ], axis=1)
+            for i in range(spk)
+        ], axis=1)  # [batch, pred, target]
+        for b in range(4):
+            rows, cols = linear_sum_assignment(-mtx[b].T)  # rows=target, cols=pred
+            sp_best = mtx[b].T[rows, cols].mean()
+            np.testing.assert_allclose(float(best[b]), sp_best, rtol=1e-4)
+
+    def test_module_metric_large_spk(self):
+        """The module metric falls back to the eager host path under its own
+        jit attempt and still computes."""
+        from metrics_tpu.audio import PermutationInvariantTraining
+
+        rng = np.random.default_rng(13)
+        m = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio)
+        for _ in range(2):
+            m.update(
+                jnp.asarray(rng.normal(size=(2, 9, 60)), jnp.float32),
+                jnp.asarray(rng.normal(size=(2, 9, 60)), jnp.float32),
+            )
+        assert np.isfinite(float(m.compute()))
+
+    def test_lap_rejects_non_finite(self):
+        from metrics_tpu._native import lap_batch
+
+        cost = np.zeros((1, 4, 4))
+        cost[0, 1, 2] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            lap_batch(cost)
