@@ -69,17 +69,22 @@ def box_iou(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
 
 
-def segm_iou(det_masks: List[np.ndarray], gt_masks: List[np.ndarray]) -> np.ndarray:
-    """Pairwise mask IoU via the native RLE codec (COCO convention)."""
-    from metrics_tpu._native import rle_encode, rle_iou
+def segm_iou_rles(det_rles: List[np.ndarray], gt_rles: List[np.ndarray]) -> np.ndarray:
+    """Pairwise IoU of RLE-encoded masks over one canvas (COCO convention)."""
+    from metrics_tpu._native import rle_iou
 
-    det_rles = [rle_encode(m) for m in det_masks]
-    gt_rles = [rle_encode(m) for m in gt_masks]
     out = np.zeros((len(det_rles), len(gt_rles)))
     for i, d in enumerate(det_rles):
         for j, g in enumerate(gt_rles):
             out[i, j] = rle_iou(d, g)
     return out
+
+
+def segm_iou(det_masks: List[np.ndarray], gt_masks: List[np.ndarray]) -> np.ndarray:
+    """Pairwise mask IoU via the native RLE codec (COCO convention)."""
+    from metrics_tpu._native import rle_encode
+
+    return segm_iou_rles([rle_encode(m) for m in det_masks], [rle_encode(m) for m in gt_masks])
 
 
 # ---------------------------------------------------------------------------
@@ -190,11 +195,15 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_counts", default=[], dist_reduce_fx=None)
         if iou_type == "segm":
-            # (N_i, H, W) uint8 stacks; registered so forward/merge/pickle
-            # handle them like every other list state (multi-host sync of
-            # masks additionally requires uniform H x W across images)
-            self.add_state("detection_masks", default=[], dist_reduce_fx=None)
-            self.add_state("groundtruth_masks", default=[], dist_reduce_fx=None)
+            # masks are RLE-encoded at update time with the first-party C++
+            # codec: states are flat 1-D run arrays plus per-mask run counts,
+            # which cat-gather across hosts like any other list state — no
+            # uniform-HxW constraint (each image keeps its own canvas; IoU
+            # pairs always live on one image's canvas)
+            self.add_state("detection_mask_runs", default=[], dist_reduce_fx=None)
+            self.add_state("detection_mask_runcounts", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruth_mask_runs", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruth_mask_runcounts", default=[], dist_reduce_fx=None)
 
     # ------------------------------------------------------------- update
     @staticmethod
@@ -227,12 +236,23 @@ class MeanAveragePrecision(Metric):
         # states stay host-side numpy: the whole protocol is host-orchestrated,
         # and device-resident list entries would pay one device->host transfer
         # per image per state at compute time (catastrophic over a TPU tunnel)
+        if self.iou_type == "segm":
+            from metrics_tpu._native import rle_encode
         for item_p, item_t in zip(preds, target):
             if self.iou_type == "segm":
                 det_masks = np.asarray(item_p["masks"]).astype(np.uint8)
                 gt_masks = np.asarray(item_t["masks"]).astype(np.uint8)
-                self.detection_masks.append(det_masks)
-                self.groundtruth_masks.append(gt_masks)
+                self._check_mask_canvas(det_masks, gt_masks)
+                det_rles = [rle_encode(m) for m in det_masks]
+                gt_rles = [rle_encode(m) for m in gt_masks]
+                self.detection_mask_runs.append(
+                    np.concatenate(det_rles) if det_rles else np.zeros(0, np.uint32)
+                )
+                self.detection_mask_runcounts.append(np.asarray([len(r) for r in det_rles], np.int64))
+                self.groundtruth_mask_runs.append(
+                    np.concatenate(gt_rles) if gt_rles else np.zeros(0, np.uint32)
+                )
+                self.groundtruth_mask_runcounts.append(np.asarray([len(r) for r in gt_rles], np.int64))
                 det_boxes = np.zeros((len(det_masks), 4))
                 gt_boxes = np.zeros((len(gt_masks), 4))
             else:
@@ -246,11 +266,37 @@ class MeanAveragePrecision(Metric):
             self.groundtruth_labels.append(np.array(item_t["labels"], dtype=np.int64, copy=True).reshape(-1))
             self.groundtruth_counts.append(np.asarray([gt_boxes.shape[0]], np.int32))
 
+    @staticmethod
+    def _check_mask_canvas(det_masks: np.ndarray, gt_masks: np.ndarray) -> None:
+        dd = tuple(det_masks.shape[-2:]) if det_masks.ndim == 3 and det_masks.shape[0] else None
+        gg = tuple(gt_masks.shape[-2:]) if gt_masks.ndim == 3 and gt_masks.shape[0] else None
+        if dd is not None and gg is not None and dd != gg:
+            raise ValueError(
+                f"Prediction and target masks of one image must share a canvas, got {dd} vs {gg}"
+            )
+
     # ------------------------------------------------------------ compute
-    def _area(self, boxes: np.ndarray, masks: Optional[List[np.ndarray]]) -> np.ndarray:
-        if self.iou_type == "segm":
-            return np.asarray([int(m.sum()) for m in (masks or [])], dtype=np.float64)
-        return box_area(boxes)
+    @staticmethod
+    def _split_rles(runs_state: Any, runcounts_state: Any, img_counts: np.ndarray) -> List[List[np.ndarray]]:
+        """Rebuild per-image lists of per-mask RLE run arrays.
+
+        Pre-sync: one (runs, runcounts) list entry per image.  Post-sync both
+        states are flat 1-D arrays; ``img_counts`` (masks per image) splits
+        the runcounts, whose per-image sums then split the runs.
+        """
+        if isinstance(runcounts_state, list):
+            runcounts_pi = [np.asarray(c).reshape(-1).astype(int) for c in runcounts_state]
+            runs_pi = [np.asarray(r).reshape(-1) for r in runs_state]
+        else:
+            flat_rc = np.asarray(runcounts_state).reshape(-1).astype(int)
+            runcounts_pi = np.split(flat_rc, np.cumsum(img_counts)[:-1]) if len(img_counts) else []
+            flat_runs = np.asarray(runs_state).reshape(-1)
+            totals = [int(c.sum()) for c in runcounts_pi]
+            runs_pi = np.split(flat_runs, np.cumsum(totals)[:-1]) if totals else []
+        return [
+            list(np.split(r, np.cumsum(c)[:-1])) if len(c) else []
+            for r, c in zip(runs_pi, runcounts_pi)
+        ]
 
     @staticmethod
     def _split_per_image(entries: Any, counts: np.ndarray, tail: Tuple[int, ...]) -> List[np.ndarray]:
@@ -282,14 +328,16 @@ class MeanAveragePrecision(Metric):
         gts = self._split_per_image(self.groundtruths, gt_counts, (4,))
         gt_labels = self._split_per_image(self.groundtruth_labels, gt_counts, ())
         if self.iou_type == "segm":
-            dm = self.detection_masks
-            gm = self.groundtruth_masks
-            d_tail = np.asarray(dm[0] if isinstance(dm, list) else dm).shape[-2:]
-            g_tail = np.asarray(gm[0] if isinstance(gm, list) else gm).shape[-2:]
-            det_masks_pi = self._split_per_image(dm, det_counts, tuple(d_tail))
-            gt_masks_pi = self._split_per_image(gm, gt_counts, tuple(g_tail))
+            from metrics_tpu._native import rle_area  # used in the per-class loop
+
+            det_rles_pi = self._split_rles(
+                self.detection_mask_runs, self.detection_mask_runcounts, det_counts
+            )
+            gt_rles_pi = self._split_rles(
+                self.groundtruth_mask_runs, self.groundtruth_mask_runcounts, gt_counts
+            )
         else:
-            det_masks_pi = gt_masks_pi = None
+            det_rles_pi = gt_rles_pi = None
 
         classes = sorted(
             set(np.concatenate(det_labels).tolist() if det_labels else [])
@@ -323,12 +371,12 @@ class MeanAveragePrecision(Metric):
                 order = np.argsort(-scores, kind="mergesort")[:max_det_cap]
                 scores = scores[order]
                 if self.iou_type == "segm":
-                    d_masks = [m for m, s in zip(det_masks_pi[i], d_sel) if s]
-                    d_masks = [d_masks[j] for j in order]
-                    g_masks = [m for m, s in zip(gt_masks_pi[i], g_sel) if s]
-                    d_area = self._area(None, d_masks)
-                    g_area = self._area(None, g_masks)
-                    ious_all = segm_iou(d_masks, g_masks) if n_d and n_g else np.zeros((len(order), n_g))
+                    d_rles = [r for r, s in zip(det_rles_pi[i], d_sel) if s]
+                    d_rles = [d_rles[j] for j in order]
+                    g_rles = [r for r, s in zip(gt_rles_pi[i], g_sel) if s]
+                    d_area = np.asarray([rle_area(r) for r in d_rles], dtype=np.float64)
+                    g_area = np.asarray([rle_area(r) for r in g_rles], dtype=np.float64)
+                    ious_all = segm_iou_rles(d_rles, g_rles) if n_d and n_g else np.zeros((len(order), n_g))
                 else:
                     d_boxes = dets[i][d_sel][order]
                     g_boxes = gts[i][g_sel]

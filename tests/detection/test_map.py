@@ -233,3 +233,107 @@ class TestSegmIoU:
             [dict(masks=m1[None].astype(bool), labels=np.array([0]))],
         )
         assert float(metric2.compute()["map"]) == 0.0
+
+
+class TestSegmHardening:
+    """Round-2 segm hardening: transitive pycocotools oracle via rectangular
+    masks, per-image canvas independence, flat-state reconstruction, and the
+    empty-epoch sentinel path."""
+
+    @staticmethod
+    def _rounded_fixture():
+        def rnd(d):
+            out = dict(d)
+            out["boxes"] = np.round(d["boxes"])
+            return out
+
+        preds = [[rnd(p) for p in batch] for batch in PREDS]
+        target = [[rnd(t) for t in batch] for batch in TARGET]
+        return preds, target
+
+    @staticmethod
+    def _to_masks(batch_p, batch_t):
+        out_p, out_t = [], []
+        for p, t in zip(batch_p, batch_t):
+            all_boxes = np.concatenate([p["boxes"], t["boxes"]])
+            h = int(all_boxes[:, 3].max()) + 3
+            w = int(all_boxes[:, 2].max()) + 7  # canvases differ per image
+
+            def masks(boxes):
+                ms = np.zeros((len(boxes), h, w), np.uint8)
+                for i, (x1, y1, x2, y2) in enumerate(boxes.astype(int)):
+                    ms[i, y1:y2, x1:x2] = 1
+                return ms
+
+            out_p.append(dict(masks=masks(p["boxes"]), scores=p["scores"], labels=p["labels"]))
+            out_t.append(dict(masks=masks(t["boxes"]), labels=t["labels"]))
+        return out_p, out_t
+
+    def test_rect_masks_match_bbox_protocol(self):
+        """Rect masks on integral boxes have identical IoUs and areas to the
+        boxes, so the pycocotools-pinned bbox path is a transitive oracle
+        for the whole segm protocol (incl. area ranges + per-class)."""
+        preds, target = self._rounded_fixture()
+        bbox_m = MeanAveragePrecision(class_metrics=True)
+        segm_m = MeanAveragePrecision(iou_type="segm", class_metrics=True)
+        for bp, bt in zip(preds, target):
+            bbox_m.update(bp, bt)
+            mp, mt = self._to_masks(bp, bt)
+            segm_m.update(mp, mt)
+        res_b = bbox_m.compute()
+        res_s = segm_m.compute()
+        for key in res_b:
+            np.testing.assert_allclose(
+                np.asarray(res_s[key]), np.asarray(res_b[key]), atol=1e-6, err_msg=key
+            )
+
+    def test_post_sync_flat_state_reconstructs_segm(self):
+        preds, target = self._rounded_fixture()
+        ref = MeanAveragePrecision(iou_type="segm")
+        flat = MeanAveragePrecision(iou_type="segm")
+        for bp, bt in zip(preds, target):
+            mp, mt = self._to_masks(bp, bt)
+            ref.update(mp, mt)
+            flat.update(mp, mt)
+        want = float(ref.compute()["map"])
+        from metrics_tpu.utils.data import dim_zero_cat
+
+        for name, value in list(flat._state.items()):
+            if isinstance(value, list):
+                # same axis-0 cat the real sync path applies to list states
+                flat._state[name] = np.asarray(dim_zero_cat([np.atleast_1d(v) for v in value]))
+        flat.sync_on_compute = False
+        flat._update_count = 1
+        np.testing.assert_allclose(float(flat.compute()["map"]), want, atol=1e-6)
+
+    def test_mixed_canvas_sizes_and_perfect_match(self):
+        m = MeanAveragePrecision(iou_type="segm")
+        m1 = np.zeros((1, 32, 48), np.uint8); m1[0, 4:20, 4:20] = 1
+        m2 = np.zeros((1, 64, 24), np.uint8); m2[0, 30:60, 2:20] = 1
+        m.update(
+            [dict(masks=m1, scores=np.array([0.9]), labels=np.array([0]))],
+            [dict(masks=m1, labels=np.array([0]))],
+        )
+        m.update(
+            [dict(masks=m2, scores=np.array([0.8]), labels=np.array([0]))],
+            [dict(masks=m2, labels=np.array([0]))],
+        )
+        np.testing.assert_allclose(float(m.compute()["map"]), 1.0, atol=1e-6)
+
+    def test_canvas_mismatch_within_image_raises(self):
+        m = MeanAveragePrecision(iou_type="segm")
+        with pytest.raises(ValueError, match="share a canvas"):
+            m.update(
+                [dict(masks=np.ones((1, 8, 8), np.uint8), scores=np.array([0.9]), labels=np.array([0]))],
+                [dict(masks=np.ones((1, 6, 8), np.uint8), labels=np.array([0]))],
+            )
+
+    def test_empty_epoch_returns_sentinels(self):
+        import warnings
+
+        m = MeanAveragePrecision(iou_type="segm")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            res = m.compute()
+        assert float(res["map"]) == -1.0
+        assert float(res["mar_100"]) == -1.0
