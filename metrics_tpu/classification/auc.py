@@ -6,7 +6,6 @@ import jax
 
 from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -21,15 +20,15 @@ class AUC(Metric):
     def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.reorder = reorder
-        self.add_state("x", default=[], dist_reduce_fx="cat")
-        self.add_state("y", default=[], dist_reduce_fx="cat")
+        self.add_buffer_state("x")
+        self.add_buffer_state("y")
 
     def update(self, x: Array, y: Array) -> None:
         x, y = _auc_update(x, y)
-        self.x.append(x)
-        self.y.append(y)
+        self._buffer_append("x", x)
+        self._buffer_append("y", y)
 
     def compute(self) -> Array:
-        x = dim_zero_cat(self.x)
-        y = dim_zero_cat(self.y)
+        x = self.buffer_values("x")
+        y = self.buffer_values("y")
         return _auc_compute(x, y, reorder=self.reorder)

@@ -6,7 +6,6 @@ import jax
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import DataType
 
 Array = jax.Array
@@ -41,13 +40,13 @@ class AUROC(Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode: Optional[DataType] = None
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_buffer_state("preds")
+        self.add_buffer_state("target")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._buffer_append("preds", preds)
+        self._buffer_append("target", target)
         if self.mode is not None and self.mode != mode:
             raise ValueError(
                 "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
@@ -58,8 +57,8 @@ class AUROC(Metric):
     def compute(self) -> Array:
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         return _auroc_compute(
             preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )

@@ -10,7 +10,6 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_update,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -35,21 +34,21 @@ class AveragePrecision(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
         self.average = average
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_buffer_state("preds")
+        self.add_buffer_state("target")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )
-        self.preds.append(preds)
-        self.target.append(target)
+        self._buffer_append("preds", preds)
+        self._buffer_append("target", target)
         self.num_classes = num_classes
         self.pos_label = pos_label
 
     def compute(self) -> Union[List[Array], Array]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         return _average_precision_compute(
             preds, target, self.num_classes, self.pos_label, self.average
         )

@@ -1,8 +1,10 @@
 """PrecisionRecallCurve module metric
 (reference ``/root/reference/src/torchmetrics/classification/precision_recall_curve.py:28``).
 
-List-state metric (O(dataset) memory, like the reference); the constant-memory
-jittable alternative is ``BinnedPrecisionRecallCurve``.
+O(dataset) memory like the reference, but stored as capacity-bounded device
+buffers (doubling growth, jit-stable traces) instead of the reference's
+per-batch tensor lists; the constant-memory jittable alternative is
+``BinnedPrecisionRecallCurve``.
 """
 
 from typing import Any, List, Optional, Tuple, Union
@@ -14,7 +16,6 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_update,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -34,19 +35,19 @@ class PrecisionRecallCurve(Metric):
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_buffer_state("preds")
+        self.add_buffer_state("target")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
-        self.preds.append(preds)
-        self.target.append(target)
+        self._buffer_append("preds", preds)
+        self._buffer_append("target", target)
         self.num_classes = num_classes
         self.pos_label = pos_label
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

@@ -6,13 +6,12 @@ import jax
 
 from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
 from metrics_tpu.functional.classification.roc import _roc_compute
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
 class ROC(PrecisionRecallCurve):
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         return _roc_compute(preds, target, self.num_classes, self.pos_label)

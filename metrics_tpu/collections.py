@@ -160,14 +160,27 @@ class MetricCollection:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update once per compute group (reference :161-189)."""
+        self._update_via("update", *args, **kwargs)
+
+    def update_batched(self, *args: Any, **kwargs: Any) -> None:
+        """Fold a stack of batches once per compute group in one program each.
+
+        The collection analogue of :meth:`Metric.update_batched`: every array
+        leaf carries a leading ``n_batches`` axis and each group leader scans
+        the stack on device in a single dispatch.
+        """
+        self._update_via("update_batched", *args, **kwargs)
+
+    def _update_via(self, method_name: str, *args: Any, **kwargs: Any) -> None:
+        """Shared grouped/ungrouped dispatch for update and update_batched."""
         if self._groups_checked:
             for group in self._compute_groups.values():
                 leader = self._modules[group[0]]
-                leader.update(*args, **leader._filter_kwargs(**kwargs))
+                getattr(leader, method_name)(*args, **leader._filter_kwargs(**kwargs))
             self._share_group_states()
         else:
             for m in self._modules.values():
-                m.update(*args, **m._filter_kwargs(**kwargs))
+                getattr(m, method_name)(*args, **m._filter_kwargs(**kwargs))
             if self._enable_compute_groups:
                 self._merge_compute_groups()
                 self._groups_checked = True
@@ -210,6 +223,9 @@ class MetricCollection:
                     return False
                 if not all(allclose(a, b) for a, b in zip(s1, s2)):
                     return False
+            elif isinstance(s1, (int, tuple)):  # buffer-state row counts
+                if s1 != s2:
+                    return False
             else:
                 if not allclose(s1, s2):
                     return False
@@ -219,6 +235,16 @@ class MetricCollection:
         """Point members at the leader's state arrays (immutable → safe)."""
         for group in self._compute_groups.values():
             leader = self._modules[group[0]]
+            if len(group) > 1:
+                # shared buffers must never be donated to a jitted update: a
+                # member's donation would invalidate the aliases every other
+                # member holds (Metric docstring, ``donate_state``)
+                for name in group:
+                    m = self._modules[name]
+                    if m.donate_state:
+                        m.donate_state = False
+                        m._jitted_update = None
+                        m._jitted_update_batched = None
             for name in group[1:]:
                 member = self._modules[name]
                 for key in member._defaults:
@@ -228,6 +254,11 @@ class MetricCollection:
                     # (e.g. after add_metrics re-opens group detection) cannot
                     # append through an alias into the leader's list
                     member._state[key] = list(value) if isinstance(value, list) else value
+                for bname in member._buffer_states:
+                    # host-side row bookkeeping must track the aliased state,
+                    # or a later direct update on the member drops rows
+                    if bname + "__buf" in member._state:
+                        member._refresh_buffer_meta(bname)
                 member._update_count = leader._update_count
                 member._computed = None
 

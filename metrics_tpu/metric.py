@@ -26,6 +26,7 @@ TPU-first redesign (SURVEY.md §7 delta 1):
 import copy
 import functools
 import numbers
+import warnings
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -45,6 +46,20 @@ _ALLOWED_REDUCE = ("sum", "mean", "max", "min", "cat")
 
 def _is_jittable_leaf(x: Any) -> bool:
     return isinstance(x, (jax.Array, np.ndarray, numbers.Number, bool)) or x is None
+
+
+class _quiet_donation(warnings.catch_warnings):
+    """Suppress jax's 'Some donated buffers were not usable' noise.
+
+    Scalar state leaves (counters) cannot alias inside a scan carry; the
+    donation of the array states still succeeds, so the warning is expected
+    and carries no signal for metric users.
+    """
+
+    def __enter__(self):
+        out = super().__enter__()
+        warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+        return out
 
 
 def jit_distributed_available() -> bool:
@@ -70,6 +85,13 @@ class Metric(ABC):
         axis_name: mesh axis name to sync over when running inside
             ``shard_map``/``pmap``.
         jit_update / jit_compute: override the class-level jit policy.
+        donate_state: donate the state buffers to the jitted update (default
+            True).  XLA then updates state in place instead of allocating a
+            fresh buffer per step — HBM-neutral streaming, which matters for
+            large states (FID's 2048x2048 covariance sums).  Caller-held
+            references to *pre-update* state arrays become invalid after the
+            next update; ``MetricCollection`` turns donation off for metrics
+            whose state it shares across a compute group.
     """
 
     __jit_state_unsafe__ = False  # set True on metrics whose update cannot trace
@@ -86,6 +108,12 @@ class Metric(ABC):
         self._defaults: Dict[str, Any] = {}
         self._reduce_fns: Dict[str, Any] = {}
         self._persistent: Dict[str, bool] = {}
+        # capacity-bounded buffer states (SURVEY §7 delta 2(b)):
+        # name -> {count, capacity, alloc_cap, trail, dtype}
+        self._buffer_states: Dict[str, Dict[str, Any]] = {}
+        self._buffer_rows_by_sig: Dict[Any, Dict[str, int]] = {}
+        self._recording_rows: Optional[Dict[str, int]] = None
+        self._state_swapped = False
 
         self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
         self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
@@ -96,6 +124,7 @@ class Metric(ABC):
         self.jit_update = kwargs.pop("jit_update", self.jit_update_default)
         self.jit_compute = kwargs.pop("jit_compute", self.jit_compute_default)
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
+        self.donate_state = kwargs.pop("donate_state", True)
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
 
@@ -105,6 +134,7 @@ class Metric(ABC):
         self._cache: Optional[Dict[str, Any]] = None
         self._cached_count: int = 0
         self._jitted_update: Optional[Callable] = None
+        self._jitted_update_batched: Optional[Callable] = None
         self._jitted_compute: Optional[Callable] = None
         self._update_called_warned = False
         self._dtype = jnp.float32
@@ -157,7 +187,178 @@ class Metric(ABC):
         self._defaults[name] = default
         self._reduce_fns[name] = dist_reduce_fx
         self._persistent[name] = persistent
-        self._state[name] = copy.copy(value) if isinstance(value, list) else value
+        # live state must not alias the stored default: the jitted update
+        # donates state buffers, and a donated default would poison every
+        # future reset()
+        self._state[name] = copy.copy(value) if isinstance(value, list) else jnp.array(value, copy=True)
+
+    # ------------------------------------------------------- buffer states
+    def add_buffer_state(
+        self,
+        name: str,
+        dist_reduce_fx: str = "cat",
+        capacity: int = 256,
+        persistent: bool = False,
+    ) -> None:
+        """Register a capacity-bounded streaming buffer (SURVEY §7 delta 2(b)).
+
+        Functionally a ``cat`` list state, but stored as ONE padded device
+        buffer (``<name>__buf``, grown by doubling) plus a row count
+        (``<name>__len``) — the TPU-native layout: the update stays a
+        fixed-shape ``dynamic_update_slice`` that jit traces once per
+        capacity, instead of an ever-growing Python list that defeats jit
+        entirely.  Rows are appended with :meth:`_buffer_append` in ``update``
+        and read back with :meth:`buffer_values` in ``compute``.
+
+        Replaces the reference's list states for the curve metrics
+        (reference ``precision_recall_curve.py`` / ``auroc.py`` keep
+        ``preds``/``target`` lists, ``classification/auroc.py:144-152``).
+        """
+        if dist_reduce_fx != "cat":
+            raise ValueError("buffer states currently support only 'cat' reduction")
+        self._buffer_states[name] = {
+            "count": 0,
+            "capacity": int(capacity),
+            "alloc_cap": 0,
+            "trail": None,
+            "dtype": None,
+        }
+        # placeholders until the first append fixes trailing shape + dtype
+        self.add_state(name + "__buf", jnp.zeros((0,), jnp.float32), dist_reduce_fx="cat", persistent=persistent)
+        self.add_state(name + "__len", jnp.zeros((), jnp.int32), dist_reduce_fx="sum", persistent=persistent)
+        # the count lives as a PYTHON INT while concrete: ints stay at the
+        # Python level inside shard_map/jit traces (never intercepted), so a
+        # statically-known count keeps in-trace sync + compute shape-static
+        self._defaults[name + "__len"] = 0
+        self._state[name + "__len"] = 0
+
+    def _buffer_append(self, name: str, values: Array) -> None:
+        """Append rows to a buffer state; grows capacity by doubling (eager)."""
+        import jax.core
+
+        meta = self._buffer_states[name]
+        bkey, lkey = name + "__buf", name + "__len"
+        values = jnp.asarray(values)
+        if values.ndim == 0:
+            values = values[None]
+        rows = values.shape[0]
+        buf, cnt = self._state[bkey], self._state[lkey]
+        concrete_cnt = not isinstance(cnt, jax.core.Tracer)
+        if (
+            concrete_cnt
+            and self.compute_on_cpu
+            and not self._state_swapped
+            and not isinstance(values, jax.core.Tracer)
+        ):
+            # host-resident accumulation: the device computes the rows, the
+            # padded buffer lives (and grows) in host memory
+            values = jax.device_put(values, jax.devices("cpu")[0])
+        if concrete_cnt:
+            cur = int(cnt)
+            cnt = cur  # python int: stays static inside a trace
+            trail = tuple(values.shape[1:])
+            if buf.ndim != values.ndim or tuple(buf.shape[1:]) != trail or buf.shape[0] == 0 or cur == 0:
+                # (re)allocate for this trailing shape/dtype
+                cap = max(meta["capacity"], 1)
+                while cap < cur + rows:
+                    cap *= 2
+                new = jnp.zeros((cap,) + trail, values.dtype)
+                if cur:
+                    new = jax.lax.dynamic_update_slice_in_dim(
+                        new, buf[:cur].astype(values.dtype), 0, axis=0
+                    )
+                buf = new
+            else:
+                # dtype promotion, matching what list-state concatenation did:
+                # int rows followed by float rows must not truncate the floats
+                promoted = jnp.promote_types(buf.dtype, values.dtype)
+                if jnp.dtype(buf.dtype) != jnp.dtype(promoted):
+                    buf = buf.astype(promoted)
+                if cur + rows > buf.shape[0]:
+                    cap = buf.shape[0]
+                    while cap < cur + rows:
+                        cap *= 2
+                    pad = jnp.zeros((cap - buf.shape[0],) + tuple(buf.shape[1:]), buf.dtype)
+                    buf = jnp.concatenate([buf, pad], axis=0)
+        elif buf.shape[0] < rows:
+            raise MetricsTPUUserError(
+                f"buffer state {name!r} enters a traced update with capacity "
+                f"{buf.shape[0]} < {rows} incoming rows; pre-size it (the "
+                "update wrapper does this automatically outside jit)"
+            )
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, values.astype(buf.dtype), cnt, axis=0)
+        self._state[bkey] = buf
+        self._state[lkey] = cnt + rows
+        eager = concrete_cnt and not isinstance(values, jax.core.Tracer) and not isinstance(buf, jax.core.Tracer)
+        if eager and not self._state_swapped:
+            meta["count"] = int(cnt) + rows
+            meta["trail"] = tuple(values.shape[1:])
+            meta["dtype"] = buf.dtype
+            meta["alloc_cap"] = buf.shape[0]
+            if self._recording_rows is not None:
+                self._recording_rows[name] = self._recording_rows.get(name, 0) + rows
+
+    def _ensure_buffer_capacity(self, name: str, incoming_rows: int) -> None:
+        """Grow a buffer (eagerly) so a traced append of ``incoming_rows`` fits."""
+        meta = self._buffer_states[name]
+        if meta["trail"] is None:
+            return  # not yet allocated; the eager first run handles it
+        bkey = name + "__buf"
+        buf = self._state[bkey]
+        need = meta["count"] + incoming_rows
+        if need <= buf.shape[0]:
+            return
+        cap = max(buf.shape[0], meta["capacity"], 1)
+        while cap < need:
+            cap *= 2
+        pad = jnp.zeros((cap - buf.shape[0],) + tuple(buf.shape[1:]), buf.dtype)
+        self._state[bkey] = jnp.concatenate([buf, pad], axis=0)
+        meta["alloc_cap"] = cap
+
+    @staticmethod
+    def _extract_buffer_values(state: Dict[str, Any], name: str) -> Array:
+        """Valid rows of a buffer state snapshot (concrete lengths only).
+
+        ``<name>__len`` forms: python int (live state), int tuple (static
+        per-device lengths after an in-trace gather), scalar array, or a
+        ``(D,)`` array of per-device lengths (dynamic padded gather).
+        """
+        buf = state[name + "__buf"]
+        cnt = state[name + "__len"]
+        if isinstance(cnt, (tuple, list)) or (not isinstance(cnt, int) and jnp.asarray(cnt).ndim == 1):
+            # per-device lengths over a (D*cap, ...) padded gather
+            lengths = [int(c) for c in (cnt if isinstance(cnt, (tuple, list)) else np.asarray(cnt))]
+            d = len(lengths)
+            cap = buf.shape[0] // max(d, 1)
+            parts = [buf[i * cap : i * cap + c] for i, c in enumerate(lengths)]
+            return jnp.concatenate(parts, axis=0) if parts else buf[:0]
+        return buf[: int(cnt)]
+
+    def buffer_values(self, name: str) -> Array:
+        """The valid rows of buffer state ``name`` (compute-side accessor)."""
+        return self._extract_buffer_values(self._state, name)
+
+    def _refresh_buffer_meta(self, name: str) -> None:
+        """Re-derive host-side buffer bookkeeping from the (concrete) state."""
+        meta = self._buffer_states[name]
+        buf = self._state[name + "__buf"]
+        cnt = jnp.asarray(self._state[name + "__len"])
+        meta["count"] = int(cnt) if cnt.ndim == 0 else int(np.asarray(cnt).sum())
+        meta["alloc_cap"] = buf.shape[0]
+        if buf.shape[0]:
+            meta["trail"] = tuple(buf.shape[1:])
+            meta["dtype"] = buf.dtype
+
+
+    def _buffer_rows_signature(self, args: tuple, kwargs: dict) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (
+            treedef,
+            tuple(
+                (getattr(leaf, "shape", None), str(getattr(leaf, "dtype", type(leaf).__name__)))
+                for leaf in leaves
+            ),
+        )
 
     def __getattr__(self, name: str) -> Any:
         state = self.__dict__.get("_state")
@@ -186,22 +387,29 @@ class Metric(ABC):
 
     # ----------------------------------------------------------- pure kernels
     def init_state(self) -> Dict[str, Any]:
-        """Fresh default state pytree (pure API)."""
+        """Fresh default state pytree (pure API).
+
+        Buffer-state counts stay python ints so they remain static inside a
+        ``shard_map``/``jit`` trace.
+        """
         return {
-            k: (list(v) if isinstance(v, list) else jnp.asarray(v))
+            k: (list(v) if isinstance(v, list) else (v if isinstance(v, int) else jnp.asarray(v)))
             for k, v in self._defaults.items()
         }
 
     def _run_with_state(self, state: Dict[str, Any], fn: Callable, args: tuple, kwargs: dict) -> Any:
         """Run an imperative method body against a swapped-in state pytree."""
         old = self.__dict__["_state"]
+        old_swapped = self._state_swapped
         object.__setattr__(self, "_state", dict(state))
+        object.__setattr__(self, "_state_swapped", True)
         try:
             out = fn(*args, **kwargs)
             new_state = {k: self._state[k] for k in state}
             return out, new_state
         finally:
             object.__setattr__(self, "_state", old)
+            object.__setattr__(self, "_state_swapped", old_swapped)
 
     def apply_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Pure update: ``(state, batch) -> state``.
@@ -221,10 +429,48 @@ class Metric(ABC):
         value, _ = self._run_with_state(state, self._compute_impl, (), {})
         return value
 
-    def merge_state(self, other_state: Dict[str, Any]) -> None:
-        """Fold another instance's state into this one (host-side tree-merge)."""
+    def merge_state(self, other_state: Dict[str, Any], other_count: Optional[int] = None) -> None:
+        """Fold another instance's state into this one (host-side tree-merge).
+
+        Args:
+            other_state: the other instance's state pytree.
+            other_count: the other instance's ``update_count``.  When given,
+                ``mean`` states merge count-weighted — exact for shards that
+                saw unequal numbers of batches.  When omitted, ``mean`` falls
+                back to the unweighted two-way average (the reference's
+                stack->mean has the same equal-shard assumption).
+        """
+        if other_count is not None:
+            mine, theirs = float(self._update_count), float(other_count)
+            total = mine + theirs
+            w_a = mine / total if total else 0.5
+            w_b = theirs / total if total else 0.5
+        else:
+            w_a = w_b = 0.5
+        other_state = dict(other_state)
+        skip_keys = set()
+        for bname in self._buffer_states:
+            bkey, lkey = bname + "__buf", bname + "__len"
+            if bkey not in self._state:
+                continue
+            mine = self._extract_buffer_values(self._state, bname)
+            theirs = self._extract_buffer_values(other_state, bname)
+            if mine.shape[0] == 0 and (mine.ndim != theirs.ndim or mine.dtype != theirs.dtype):
+                # self never appended: its buffer is the (0,)-float32
+                # placeholder, whose rank/dtype must not leak into the merge
+                self._state[bkey] = theirs
+            elif theirs.shape[0] == 0:
+                self._state[bkey] = mine
+            else:
+                dt = jnp.promote_types(mine.dtype, theirs.dtype)
+                self._state[bkey] = jnp.concatenate([mine.astype(dt), theirs.astype(dt)], axis=0)
+            self._state[lkey] = int(self._state[bkey].shape[0])
+            self._refresh_buffer_meta(bname)
+            skip_keys.update((bkey, lkey))
         merged = {}
         for name, value in self._state.items():
+            if name in skip_keys:
+                continue
             other = other_state[name]
             fx = self._reduce_fns[name]
             if isinstance(value, list):
@@ -238,7 +484,7 @@ class Metric(ABC):
             elif fx == "sum":
                 merged[name] = value + other
             elif fx == "mean":
-                merged[name] = (value + other) / 2.0
+                merged[name] = w_a * value + w_b * other
             elif fx == "max":
                 merged[name] = jnp.maximum(value, other)
             elif fx == "min":
@@ -250,9 +496,38 @@ class Metric(ABC):
             else:
                 raise ValueError(f"cannot merge state {name!r} with reduce {fx!r}")
         self._state.update(merged)
+        if other_count is not None:
+            self._update_count += int(other_count)
+        self._computed = None
 
     def _sync_state_pure(self, state: Dict[str, Any], backend: Backend) -> Dict[str, Any]:
-        out = {}
+        import jax.core
+
+        state = dict(state)
+        out: Dict[str, Any] = {}
+        for bname in self._buffer_states:
+            bkey, lkey = bname + "__buf", bname + "__len"
+            if bkey not in state:
+                continue
+            buf, cnt = state.pop(bkey), state.pop(lkey)
+            if isinstance(cnt, jax.core.Tracer):
+                # traced collective (AxisBackend) with dynamic lengths: gather
+                # the padded buffers plus per-device lengths; an eager compute
+                # re-assembles the valid rows afterwards
+                out[bkey] = backend.all_gather_cat(buf)
+                out[lkey] = backend.all_gather_stack(jnp.atleast_1d(jnp.asarray(cnt))).reshape(-1)
+            elif isinstance(buf, jax.core.Tracer):
+                # traced collective, but the count is a trace-time constant —
+                # one program runs on every device, so all lengths equal it;
+                # an int tuple keeps the lengths static and compute can run
+                # fully in-trace
+                out[bkey] = backend.all_gather_cat(buf)
+                out[lkey] = tuple([int(cnt)] * backend.world_size())
+            else:
+                vals = self._extract_buffer_values({bkey: buf, lkey: cnt}, bname)
+                gathered = backend.all_gather_cat(vals)
+                out[bkey] = gathered
+                out[lkey] = int(gathered.shape[0])
         for name, value in state.items():
             fx = self._reduce_fns[name]
             if isinstance(value, list):
@@ -279,6 +554,10 @@ class Metric(ABC):
             return False
         if self._has_list_state():
             return False
+        if self.compute_on_cpu and self._buffer_states:
+            # buffer accumulators live on host under compute_on_cpu; a jitted
+            # device update would defeat that (and mix committed devices)
+            return False
         leaves = jax.tree_util.tree_leaves((args, kwargs))
         return all(_is_jittable_leaf(leaf) for leaf in leaves)
 
@@ -297,15 +576,37 @@ class Metric(ABC):
         self._pre_update(*args, **kwargs)
         self._computed = None
         self._update_count += 1
-        if self._can_jit(args, kwargs):
+        use_jit = self._can_jit(args, kwargs)
+        buffer_rows: Optional[Dict[str, int]] = None
+        if use_jit and self._buffer_states:
+            sig = self._buffer_rows_signature(args, kwargs)
+            buffer_rows = self._buffer_rows_by_sig.get(sig)
+            if buffer_rows is None:
+                # first batch of this input signature: run eagerly, recording
+                # how many rows each buffer receives, so later traced updates
+                # can be capacity-ensured without a device sync
+                self._recording_rows = {}
+                try:
+                    self._update_impl(*args, **kwargs)
+                    self._buffer_rows_by_sig[sig] = self._recording_rows
+                finally:
+                    self._recording_rows = None
+                if self.compute_on_cpu:
+                    self._move_list_states_to_cpu()
+                return
+            for bname, rows in buffer_rows.items():
+                self._ensure_buffer_capacity(bname, rows)
+        if use_jit:
             if self._jitted_update is None:
                 def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
                     _, new_state = self._run_with_state(state, self._update_impl, args, kwargs)
                     return new_state
 
-                self._jitted_update = jax.jit(pure_update)
+                donate = (0,) if self.donate_state else ()
+                self._jitted_update = jax.jit(pure_update, donate_argnums=donate)
             try:
-                new_state = self._jitted_update(self._state, args, kwargs)
+                with _quiet_donation():
+                    new_state = self._jitted_update(self._state, args, kwargs)
             except (
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError,
@@ -318,17 +619,151 @@ class Metric(ABC):
                 self._update_impl(*args, **kwargs)
             else:
                 self._state.update(new_state)
+                if buffer_rows:
+                    for bname, rows in buffer_rows.items():
+                        meta = self._buffer_states[bname]
+                        meta["count"] += rows
+                        # keep the count a python int (static in later traces)
+                        self._state[bname + "__len"] = meta["count"]
         else:
             self._update_impl(*args, **kwargs)
         if self.compute_on_cpu:
             self._move_list_states_to_cpu()
 
+    def update_batched(self, *args: Any, **kwargs: Any) -> None:
+        """Fold a STACK of batches into state in ONE compiled program.
+
+        Every array leaf of ``args``/``kwargs`` must carry an identical
+        leading ``n_batches`` axis.  Semantically equivalent to calling
+        :meth:`update` once per leading-axis slice, but the per-batch fold
+        runs as a ``lax.scan`` on device, so the whole stream costs a single
+        host->device dispatch.  Through a tunnel or an async dispatch queue,
+        host dispatch — not FLOPs — bounds streaming-update throughput; this
+        is the TPU-native shape of the reference's eager update loop
+        (reference ``metric.py:241-280`` runs one Python call per batch).
+
+        Non-array arguments (flags like FID's ``real=True``) pass through
+        unchanged to every slice.  Falls back to the per-slice Python loop for
+        list states and non-jittable inputs.
+        """
+        all_leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        is_batched = [hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 for x in all_leaves]
+        batched = [x for x, b in zip(all_leaves, is_batched) if b]
+        if not batched:
+            raise MetricsTPUUserError(
+                "update_batched needs array inputs with a leading n_batches axis"
+            )
+        n = batched[0].shape[0]
+        if any(x.shape[0] != n for x in batched):
+            raise MetricsTPUUserError(
+                "update_batched: all array inputs must share the leading n_batches axis; "
+                f"got sizes {sorted({x.shape[0] for x in batched})}"
+            )
+        if n == 0:
+            return  # an empty stack is zero update() calls
+        statics = tuple(None if b else x for x, b in zip(all_leaves, is_batched))
+
+        def _slice(index) -> tuple:
+            """(args, kwargs) at one slice/range; non-array leaves unchanged."""
+            it = (x[index] for x, b in zip(all_leaves, is_batched) if b)
+            leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        def _loop_fallback(start: int = 0) -> None:
+            for i in range(start, n):
+                sl_args, sl_kwargs = _slice(i)
+                self._update_wrapper(*sl_args, **sl_kwargs)
+
+        if not self._can_jit(args, kwargs):
+            _loop_fallback()
+            return
+        if self._is_synced:
+            raise MetricsTPUUserError(
+                "The Metric has already been synced; re-syncing or updating while synced is forbidden."
+            )
+        first_args, first_kwargs = _slice(0)
+        self._pre_update(*first_args, **first_kwargs)
+        skip = 0
+        buffer_rows: Optional[Dict[str, int]] = None
+        if self._buffer_states:
+            sig = self._buffer_rows_signature(first_args, first_kwargs)
+            buffer_rows = self._buffer_rows_by_sig.get(sig)
+            if buffer_rows is None:
+                # record per-slice rows on the first slice, then scan the rest
+                self._update_wrapper(*first_args, **first_kwargs)
+                buffer_rows = self._buffer_rows_by_sig.get(sig)
+                if buffer_rows is None:  # body turned out untraceable
+                    _loop_fallback(start=1)
+                    return
+                skip = 1
+                if n - skip == 0:
+                    return
+            for bname, rows in buffer_rows.items():
+                self._ensure_buffer_capacity(bname, rows * (n - skip))
+        try:
+            statics_key = (treedef, statics)
+            hash(statics_key)
+        except TypeError:
+            _loop_fallback(start=skip)
+            return
+        if self._jitted_update_batched is None:
+            self._jitted_update_batched = {}
+        fused = self._jitted_update_batched.get(statics_key)
+        if fused is None:
+            def pure_update_many(state: Dict[str, Any], arr_stack: tuple) -> Dict[str, Any]:
+                def body(st: Dict[str, Any], sl: tuple) -> tuple:
+                    it = iter(sl)
+                    leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
+                    sl_args, sl_kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+                    _, new = self._run_with_state(st, self._update_impl, sl_args, sl_kwargs)
+                    return new, None
+
+                new_state, _ = jax.lax.scan(body, state, arr_stack)
+                return new_state
+
+            donate = (0,) if self.donate_state else ()
+            fused = jax.jit(pure_update_many, donate_argnums=donate)
+            self._jitted_update_batched[statics_key] = fused
+        arr_stack = tuple(x[skip:] if skip else x for x, b in zip(all_leaves, is_batched) if b)
+        try:
+            with _quiet_donation():
+                new_state = fused(self._state, arr_stack)
+        except (
+            TypeError,  # scan carry structure/dtype mismatch
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.NonConcreteBooleanIndexError,
+        ):
+            # trace-time failure: nothing executed (donated buffers intact);
+            # the eager loop either succeeds or surfaces the real error.
+            # Runtime failures (device OOM, ...) propagate — after donation
+            # the state may be consumed, so a silent fallback would corrupt it
+            self._jitted_update_batched.pop(statics_key, None)
+            _loop_fallback(start=skip)
+            return
+        self._state.update(new_state)
+        self._computed = None
+        self._update_count += int(n - skip)
+        if buffer_rows:
+            for bname, rows in buffer_rows.items():
+                meta = self._buffer_states[bname]
+                meta["count"] += rows * int(n - skip)
+                self._state[bname + "__len"] = meta["count"]
+        if self.compute_on_cpu:
+            self._move_list_states_to_cpu()
+
     def _move_list_states_to_cpu(self) -> None:
-        """Offload list states to host memory (reference ``metric.py:396-406``)."""
+        """Offload list AND buffer accumulators to host memory
+        (reference ``metric.py:396-406``)."""
         cpu = jax.devices("cpu")[0]
         for name, value in self._state.items():
             if isinstance(value, list):
                 self._state[name] = [jax.device_put(v, cpu) for v in value]
+        for bname in self._buffer_states:
+            bkey = bname + "__buf"
+            if bkey in self._state and not isinstance(self._state[bkey], list):
+                self._state[bkey] = jax.device_put(self._state[bkey], cpu)
 
     # ---------------------------------------------------------------- forward
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -404,6 +839,27 @@ class Metric(ABC):
         return batch_val
 
     def _reduce_states(self, global_state: Dict[str, Any], global_count: int) -> None:
+        global_state = dict(global_state)
+        for bname in self._buffer_states:
+            bkey, lkey = bname + "__buf", bname + "__len"
+            if bkey not in global_state:
+                continue
+            g_vals = self._extract_buffer_values(global_state, bname)
+            l_vals = self._extract_buffer_values(self._state, bname)
+            total = g_vals.shape[0] + l_vals.shape[0]
+            cap = max(self._buffer_states[bname]["capacity"], 1)
+            while cap < total:
+                cap *= 2
+            buf = jnp.zeros((cap,) + tuple(l_vals.shape[1:]), l_vals.dtype)
+            if g_vals.shape[0]:  # pre-first-forward the global buffer is the empty placeholder
+                buf = jax.lax.dynamic_update_slice_in_dim(buf, g_vals.astype(buf.dtype), 0, axis=0)
+            if l_vals.shape[0]:
+                buf = jax.lax.dynamic_update_slice_in_dim(buf, l_vals, g_vals.shape[0], axis=0)
+            self._state[bkey] = buf
+            self._state[lkey] = int(total)
+            self._refresh_buffer_meta(bname)
+            global_state.pop(bkey)
+            global_state.pop(lkey)
         for name, global_val in global_state.items():
             local_val = self._state[name]
             fx = self._reduce_fns[name]
@@ -431,6 +887,9 @@ class Metric(ABC):
 
     def _restore_state(self, cache: Dict[str, Any]) -> None:
         self._state.update({k: (list(v) if isinstance(v, list) else v) for k, v in cache.items()})
+        for bname in self._buffer_states:
+            if bname + "__buf" in self._state:
+                self._refresh_buffer_meta(bname)
 
     def sync(
         self,
@@ -538,7 +997,20 @@ class Metric(ABC):
         self._cache = None
         self._is_synced = False
         for name, default in self._defaults.items():
-            self._state[name] = [] if isinstance(default, list) else jnp.asarray(default)
+            # fresh buffer per reset — the default itself must never be donated
+            if isinstance(default, list):
+                self._state[name] = []
+            elif isinstance(default, int):
+                self._state[name] = default  # buffer counts stay python ints
+            else:
+                self._state[name] = jnp.array(default, copy=True)
+        for bname, meta in self._buffer_states.items():
+            meta["count"] = 0
+            if meta["trail"] is not None:
+                # keep the grown capacity across resets: stable jit traces
+                # from epoch to epoch, bounded memory in between
+                cap = max(meta["alloc_cap"], meta["capacity"], 1)
+                self._state[bname + "__buf"] = jnp.zeros((cap,) + meta["trail"], meta["dtype"])
 
     def clone(self) -> "Metric":
         return copy.deepcopy(self)
@@ -548,7 +1020,7 @@ class Metric(ABC):
         for name, value in self._state.items():
             if isinstance(value, list):
                 self._state[name] = [jax.device_put(v, device) for v in value]
-            else:
+            elif not isinstance(value, (int, tuple)):  # buffer counts stay host-side
                 self._state[name] = jax.device_put(value, device)
         return self
 
@@ -557,6 +1029,8 @@ class Metric(ABC):
         self._dtype = dst_type
 
         def cast(v: Array) -> Array:
+            if isinstance(v, (int, tuple)):  # buffer counts
+                return v
             return v.astype(dst_type) if jnp.issubdtype(v.dtype, jnp.floating) else v
 
         for name, value in self._state.items():
@@ -565,6 +1039,7 @@ class Metric(ABC):
             else:
                 self._state[name] = cast(value)
         self._jitted_update = None
+        self._jitted_update_batched = None
         self._jitted_compute = None
         return self
 
@@ -602,12 +1077,21 @@ class Metric(ABC):
                 self._state[name] = [jnp.asarray(v) for v in value]
             else:
                 self._state[name] = jnp.asarray(value)
+        for bname in self._buffer_states:
+            if bname + "__buf" in state_dict:
+                self._refresh_buffer_meta(bname)
 
     def state_pytree(self) -> Dict[str, Any]:
-        """Full state as an orbax-serializable pytree (list states pre-concatenated)."""
+        """Full state as an orbax-serializable pytree (list states pre-concatenated,
+        buffer states trimmed to their valid rows)."""
         out: Dict[str, Any] = {"_update_count": self._update_count}
         for name, value in self._state.items():
             out[name] = dim_zero_cat(value) if isinstance(value, list) and value else value
+        for bname in self._buffer_states:
+            bkey, lkey = bname + "__buf", bname + "__len"
+            if bkey in out:
+                out[bkey] = self._extract_buffer_values(self._state, bname)
+                out[lkey] = jnp.asarray(out[bkey].shape[0], jnp.int32)
         return out
 
     def load_state_pytree(self, tree: Dict[str, Any]) -> None:
@@ -617,6 +1101,9 @@ class Metric(ABC):
                 self._state[name] = [jnp.asarray(value)]
             else:
                 self._state[name] = jnp.asarray(value) if not isinstance(value, list) else value
+        for bname in self._buffer_states:
+            if bname + "__buf" in self._state:
+                self._refresh_buffer_meta(bname)
 
     # ------------------------------------------------------------- pickling
     def __getstate__(self) -> Dict[str, Any]:
@@ -625,13 +1112,18 @@ class Metric(ABC):
         for key in ("update", "compute", "_update_impl", "_compute_impl"):
             d.pop(key, None)
         d["_jitted_update"] = None
+        d["_jitted_update_batched"] = None
         d["_jitted_compute"] = None
         d["_state"] = {
-            k: ([np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v))
+            k: (
+                [np.asarray(x) for x in v]
+                if isinstance(v, list)
+                else (v if isinstance(v, (int, tuple)) else np.asarray(v))
+            )
             for k, v in d["_state"].items()
         }
         d["_defaults"] = {
-            k: (v if isinstance(v, list) else np.asarray(v)) for k, v in d["_defaults"].items()
+            k: (v if isinstance(v, (list, int)) else np.asarray(v)) for k, v in d["_defaults"].items()
         }
         d["_cache"] = None
         d["_computed"] = None
@@ -640,11 +1132,15 @@ class Metric(ABC):
     def __setstate__(self, d: Dict[str, Any]) -> None:
         d = dict(d)
         d["_state"] = {
-            k: ([jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v))
+            k: (
+                [jnp.asarray(x) for x in v]
+                if isinstance(v, list)
+                else (v if isinstance(v, (int, tuple)) else jnp.asarray(v))
+            )
             for k, v in d["_state"].items()
         }
         d["_defaults"] = {
-            k: (v if isinstance(v, list) else jnp.asarray(v)) for k, v in d["_defaults"].items()
+            k: (v if isinstance(v, (list, int)) else jnp.asarray(v)) for k, v in d["_defaults"].items()
         }
         self.__dict__.update(d)
         self._install_wrappers()

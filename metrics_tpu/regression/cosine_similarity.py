@@ -9,13 +9,12 @@ from metrics_tpu.functional.regression.cosine_similarity import (
     _cosine_similarity_update,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
 class CosineSimilarity(Metric):
-    """Holds the raw (N,D) batches (list state, gather-synced)."""
+    """Holds the raw (N,D) batches (buffered device state, gather-synced)."""
 
     is_differentiable = True
     higher_is_better = True
@@ -27,15 +26,15 @@ class CosineSimilarity(Metric):
         if reduction not in allowed_reduction:
             raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
         self.reduction = reduction
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_buffer_state("preds")
+        self.add_buffer_state("target")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _cosine_similarity_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._buffer_append("preds", preds)
+        self._buffer_append("target", target)
 
     def compute(self) -> Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         return _cosine_similarity_compute(preds, target, self.reduction)

@@ -9,13 +9,12 @@ from metrics_tpu.functional.regression.spearman import (
     _spearman_corrcoef_update,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
-    """Rank correlation needs the full sample — list states, gather-synced."""
+    """Rank correlation needs the full sample — buffered device states, gather-synced."""
 
     is_differentiable = False
     higher_is_better = True
@@ -23,15 +22,15 @@ class SpearmanCorrCoef(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_buffer_state("preds")
+        self.add_buffer_state("target")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _spearman_corrcoef_update(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._buffer_append("preds", preds)
+        self._buffer_append("target", target)
 
     def compute(self) -> Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         return _spearman_corrcoef_compute(preds, target)

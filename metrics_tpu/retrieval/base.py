@@ -22,7 +22,6 @@ from metrics_tpu.functional.retrieval.engine import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.checks import _check_retrieval_inputs
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -66,9 +65,9 @@ class RetrievalMetric(Metric):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
         self.ignore_index = ignore_index
 
-        self.add_state("indexes", default=[], dist_reduce_fx=None)
-        self.add_state("preds", default=[], dist_reduce_fx=None)
-        self.add_state("target", default=[], dist_reduce_fx=None)
+        self.add_buffer_state("indexes")
+        self.add_buffer_state("preds")
+        self.add_buffer_state("target")
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         """Validate, flatten and append the batch (reference ``base.py:97-108``)."""
@@ -81,14 +80,14 @@ class RetrievalMetric(Metric):
             allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,
         )
-        self.indexes.append(indexes)
-        self.preds.append(preds)
-        self.target.append(target)
+        self._buffer_append("indexes", indexes)
+        self._buffer_append("preds", preds)
+        self._buffer_append("target", target)
 
     def compute(self) -> Array:
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        indexes = self.buffer_values("indexes")
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         group, n_groups = contiguous_groups(indexes)
         scores, empty = self._group_scores(preds, target, group, n_groups)
         return reduce_over_groups(scores, empty, self.empty_target_action, self._empty_kind)

@@ -13,7 +13,6 @@ from metrics_tpu.functional.retrieval.engine import (
     precision_recall_curve_per_group,
 )
 from metrics_tpu.retrieval.base import RetrievalMetric
-from metrics_tpu.utils.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -63,9 +62,9 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         self.adaptive_k = adaptive_k
 
     def compute(self) -> Tuple[Array, Array, Array]:
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        indexes = self.buffer_values("indexes")
+        preds = self.buffer_values("preds")
+        target = self.buffer_values("target")
         group, n_groups = contiguous_groups(indexes)
 
         max_k = self.max_k

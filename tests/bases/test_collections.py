@@ -158,9 +158,9 @@ def test_explicit_compute_groups_unlisted_metric_still_updates():
     assert float(res["c"]) == -2.0
 
 
-def test_compute_groups_no_list_alias_after_add_metrics():
+def test_compute_groups_no_state_alias_double_count_after_add_metrics():
     """add_metrics re-opens group detection; the next full-update pass must not
-    double-append through aliased list states (grouped curve metrics)."""
+    double-fold batches through aliased states (grouped curve metrics)."""
     from metrics_tpu.classification import ROC, PrecisionRecallCurve
 
     rng = np.random.default_rng(3)
@@ -171,5 +171,6 @@ def test_compute_groups_no_list_alias_after_add_metrics():
     mc.update(preds, target)
     mc.add_metrics({"acc": Accuracy(num_classes=3)})
     mc.update(preds, target)
-    assert len(mc["roc"]._state["preds"]) == 2
-    assert len(mc["prc"]._state["preds"]) == 2
+    # curve metrics hold padded buffers: exactly 2 batches x 8 rows each
+    assert mc["roc"]._state["preds__len"] == 16
+    assert mc["prc"]._state["preds__len"] == 16

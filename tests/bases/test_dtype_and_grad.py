@@ -119,3 +119,90 @@ class TestNoRetracing:
         for n in (8, 8, 16, 16, 8):
             m.update(jnp.ones(n), jnp.zeros(n))
         assert m._jitted_update._cache_size() == 2
+
+
+class TestBufferedCurveStates:
+    """SURVEY §7 delta 2(b): curve metrics hold ONE padded device buffer that
+    doubles on overflow — jitted updates, log-many traces, bounded memory."""
+
+    def _stream(self, m, n_batches, batch=16):
+        for _ in range(n_batches):
+            preds = jnp.asarray(_rng.random(batch, dtype=np.float32))
+            target = jnp.asarray(_rng.integers(0, 2, batch))
+            m.update(preds, target)
+
+    def test_no_per_batch_retrace(self):
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        m = PrecisionRecallCurve()
+        self._stream(m, 40)  # 640 rows: grows 256 -> 512 -> 1024
+        assert m._jitted_update is not None
+        # one eager recording run, then one trace per capacity (256/512/1024)
+        assert m._jitted_update._cache_size() <= 3
+        assert m.update_count == 40
+
+    def test_memory_is_one_padded_buffer(self):
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        m = PrecisionRecallCurve()
+        self._stream(m, 40)
+        buf = m._state["preds__buf"]
+        assert buf.shape[0] == 1024  # pow2 ≥ 640, not one array per batch
+        assert m._state["preds__len"] == 640
+        pr, rc, th = m.compute()
+        assert np.asarray(pr).ndim == 1
+
+    def test_matches_unbuffered_reference_values(self):
+        from sklearn.metrics import precision_recall_curve as sk_prc
+
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        m = PrecisionRecallCurve()
+        all_p, all_t = [], []
+        for _ in range(7):
+            p = _rng.random(16).astype(np.float32)
+            t = _rng.integers(0, 2, 16)
+            all_p.append(p)
+            all_t.append(t)
+            m.update(jnp.asarray(p), jnp.asarray(t))
+        precision, recall, _ = m.compute()
+        sk_p, sk_r, _ = sk_prc(np.concatenate(all_t), np.concatenate(all_p))
+        # reference truncates once full recall is attained — common suffix
+        k = len(sk_p) - len(np.asarray(precision))
+        assert k >= 0 and np.all(sk_r[:k] == 1.0)
+        np.testing.assert_allclose(np.asarray(precision), sk_p[k:], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(recall), sk_r[k:], atol=1e-6)
+
+    def test_capacity_survives_reset_no_retrace(self):
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        m = PrecisionRecallCurve()
+        self._stream(m, 20)
+        traces_before = m._jitted_update._cache_size()
+        m.reset()
+        self._stream(m, 20)  # same shapes, same capacities -> no new traces
+        assert m._jitted_update._cache_size() == traces_before
+
+    def test_update_batched_stream(self):
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        stacked_p = jnp.asarray(_rng.random((10, 16), dtype=np.float32))
+        stacked_t = jnp.asarray(_rng.integers(0, 2, (10, 16)))
+        fused, looped = PrecisionRecallCurve(), PrecisionRecallCurve()
+        fused.update_batched(stacked_p, stacked_t)
+        for i in range(10):
+            looped.update(stacked_p[i], stacked_t[i])
+        for a, b in zip(fused.compute(), looped.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        assert fused.update_count == 10
+
+    def test_forward_fast_path(self):
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        m = PrecisionRecallCurve()
+        for _ in range(3):
+            p = jnp.asarray(_rng.random(8, dtype=np.float32))
+            t = jnp.asarray(_rng.integers(0, 2, 8))
+            m.forward(p, t)
+        assert m._state["preds__len"] == 24
+        m.compute()

@@ -267,3 +267,139 @@ def test_check_forward_full_state_property(capsys):
     assert "full_state_update=true" in out.lower()
     assert "full_state_update=false" in out.lower()
     assert "recommended" in out.lower()
+
+
+# ---------------------------------------------------------- fused batched path
+class TestUpdateBatched:
+    """One-dispatch streaming: ``update_batched`` scans a stack of batches."""
+
+    def test_matches_looped_updates(self):
+        rng = np.random.default_rng(7)
+        xs = jnp.asarray(rng.random((6, 8), dtype=np.float32))
+        looped, fused = DummyMetricSum(), DummyMetricSum()
+        for i in range(6):
+            looped.update(xs[i])
+        fused.update_batched(xs)
+        assert np.allclose(looped.compute(), fused.compute())
+        assert fused.update_count == 6
+
+    def test_single_trace_for_repeated_stacks(self):
+        m = DummyMetricSum()
+        for _ in range(4):
+            m.update_batched(jnp.ones((5, 3)))
+        assert m._jitted_update_batched is not None
+        assert len(m._jitted_update_batched) == 1  # one static signature
+        (fused,) = m._jitted_update_batched.values()
+        assert fused._cache_size() == 1
+        assert m.update_count == 20
+
+    def test_static_flag_arguments_pass_through(self):
+        class FlagMetric(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("a", jnp.zeros(()), dist_reduce_fx="sum")
+                self.add_state("b", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, x, real=True):
+                if real:
+                    self.a = self.a + x.sum()
+                else:
+                    self.b = self.b + x.sum()
+
+            def compute(self):
+                return self.a - self.b
+
+        m = FlagMetric()
+        m.update_batched(jnp.ones((3, 4)), real=True)
+        m.update_batched(jnp.ones((2, 4)), real=False)
+        assert float(m.a) == 12.0 and float(m.b) == 8.0
+        assert len(m._jitted_update_batched) == 2  # one program per flag value
+
+    def test_list_state_falls_back_to_loop(self):
+        m = DummyListMetric()
+        m.update_batched(jnp.arange(4.0))
+        assert len(m.x) == 4
+        assert m.update_count == 4
+
+    def test_mismatched_leading_axis_raises(self):
+        m = DummyMetricSum()
+        with pytest.raises(MetricsTPUUserError, match="leading n_batches axis"):
+            m.update_batched(jnp.ones((3, 2)), jnp.ones((4, 2)))
+
+    def test_scalar_input_raises(self):
+        m = DummyMetricSum()
+        with pytest.raises(MetricsTPUUserError, match="leading n_batches axis"):
+            m.update_batched(jnp.asarray(1.0))
+
+    def test_update_while_synced_forbidden(self):
+        m = DummyMetricSum()
+        m.update(1.0)
+        m.sync(should_sync=False)
+        with pytest.raises(MetricsTPUUserError, match="synced"):
+            m.update_batched(jnp.ones((2, 2)))
+
+
+# ------------------------------------------------------------- state donation
+class TestStateDonation:
+    """Donated update buffers: in-place XLA streaming without poisoning
+    defaults, resets, or caller copies."""
+
+    def test_reset_after_donated_updates(self):
+        m = DummyMetricSum()
+        for _ in range(3):
+            m.update(jnp.ones(()))
+        m.reset()
+        m.update(jnp.ones(()))
+        assert float(m.compute()) == 1.0
+
+    def test_pre_update_reference_is_invalidated(self):
+        m = DummyMetricSum()
+        m.update(jnp.ones(()))
+        stale = m.x
+        m.update(jnp.ones(()))
+        with pytest.raises(RuntimeError):
+            np.asarray(stale)
+
+    def test_donation_opt_out_keeps_buffers(self):
+        m = DummyMetricSum(donate_state=False)
+        m.update(jnp.ones(()))
+        stale = m.x
+        m.update(jnp.ones(()))
+        assert float(stale) == 1.0
+        assert float(m.compute()) == 2.0
+
+    def test_forward_fast_path_with_donation(self):
+        m = DummyMetricSum()
+        vals = [float(m.forward(jnp.asarray(v))) for v in (1.0, 2.0, 3.0)]
+        assert vals == [1.0, 2.0, 3.0]
+        assert float(m.compute()) == 6.0
+
+
+def test_merge_state_weighted_mean():
+    class RunningMean(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("v", jnp.zeros(()), dist_reduce_fx="mean")
+            self.add_state("n", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.v = (self.v * self.n + x) / (self.n + 1)
+            self.n = self.n + 1
+
+        def compute(self):
+            return self.v
+
+    a, b = RunningMean(), RunningMean()
+    for x in (1.0, 2.0, 3.0):
+        a.update(jnp.asarray(x))
+    b.update(jnp.asarray(10.0))
+    a.merge_state(b.state, other_count=b.update_count)
+    assert np.isclose(float(a.compute()), 4.0)  # exact despite 3-vs-1 shards
+    assert a.update_count == 4
+    # without counts: documented equal-shard two-way average
+    c, d = RunningMean(), RunningMean()
+    for x in (1.0, 2.0, 3.0):
+        c.update(jnp.asarray(x))
+    d.update(jnp.asarray(10.0))
+    c.merge_state(d.state)
+    assert np.isclose(float(c.compute()), 6.0)

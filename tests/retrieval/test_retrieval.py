@@ -360,8 +360,8 @@ def test_retrieval_ddp_shard_map():
     for r in range(n_dev):
         m = RetrievalMAP()
         rank_state = jax.tree_util.tree_map(lambda x: x[r], synced)
-        for key, val in rank_state.items():
-            m._state[key] = [val]
+        # buffer-state layout: padded `<name>__buf` + per-device `<name>__len`
+        m._state.update(rank_state)
         m._update_count = NUM_BATCHES
         m.sync_on_compute = False
         np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
