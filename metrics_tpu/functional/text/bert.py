@@ -111,6 +111,92 @@ def _load_flax_model(model_name_or_path: str):
     return tokenizer, model
 
 
+import weakref
+
+# id(model) -> {need_hidden: call}.  The cached closures reference the model
+# only through a weakref, so a dead model's entry holds no weights; a
+# weakref.finalize hook evicts the entry itself when the model is collected.
+_jitted_call_cache: Dict[int, Dict[bool, Any]] = {}
+
+
+def _jitted_model_call(model: Any, need_hidden: bool):
+    """Per-model jitted encoder call, eager fallback for non-pytree outputs.
+
+    An eager HF-Flax forward dispatches thousands of single ops (one tunnel
+    round-trip each on remote TPU); one compiled program per (model,
+    chunk-shape) runs at device rate.  HF models get their weights passed as
+    an explicit jit ARGUMENT: weights captured by closure are lowered as
+    program constants, which bloats the HLO by the full parameter size
+    (~440MB for BERT-base) and stalls compilation.
+    """
+    try:
+        model_ref = weakref.ref(model)
+    except TypeError:
+        model_ref = lambda m=model: m  # unweakrefable: cache per call only  # noqa: E731
+        per_model: Dict[bool, Any] = {}
+    else:
+        key = id(model)
+        per_model = _jitted_call_cache.get(key)
+        if per_model is None:
+            per_model = {}
+            _jitted_call_cache[key] = per_model
+            weakref.finalize(model, _jitted_call_cache.pop, key, None)
+    cached = per_model.get(need_hidden)
+    if cached is not None:
+        return cached
+
+    takes_params = False
+    if getattr(model, "params", None) is not None:
+        import inspect
+
+        try:
+            takes_params = "params" in inspect.signature(model.__call__).parameters
+        except (TypeError, ValueError):
+            takes_params = True  # HF-style; the except path below covers misfires
+
+    if takes_params:
+        jitted = jax.jit(
+            lambda p, ids, mask, **kw: model_ref()(input_ids=ids, attention_mask=mask, params=p, **kw),
+            static_argnames=("output_hidden_states",),
+        )
+        run = lambda ids, mask, **kw: jitted(model_ref().params, ids, mask, **kw)  # noqa: E731
+    else:
+        jitted = jax.jit(
+            lambda ids, mask, **kw: model_ref()(input_ids=ids, attention_mask=mask, **kw),
+            static_argnames=("output_hidden_states",),
+        )
+        run = jitted
+
+    def eager(i, m, **k):
+        return model_ref()(input_ids=i, attention_mask=m, **k)
+
+    impl = {"fn": run}
+
+    def call(ids, mask, **kw):
+        if impl["fn"] is eager:
+            return eager(ids, mask, **kw)
+        try:
+            return run(ids, mask, **kw)
+        except (
+            TypeError,
+            ValueError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+        ):
+            # trace-level failure: output is not a registered pytree (custom
+            # user model) or the body cannot trace — run eagerly from now on
+            # (also for the remaining chunks of THIS forward; failed traces
+            # are not cached, so re-trying the jit per chunk wastes seconds).
+            # Transient RUNTIME errors (device OOM, ...) propagate instead of
+            # silently demoting the model to per-op eager dispatch.
+            impl["fn"] = eager
+            return eager(ids, mask, **kw)
+
+    per_model[need_hidden] = call
+    return call
+
+
 def _model_forward(
     model: Any,
     input_ids: np.ndarray,
@@ -147,9 +233,10 @@ def _model_forward(
                 "hidden states or a `user_forward_fn` returning the desired embeddings."
             )
     kwargs = {"output_hidden_states": True} if need_hidden else {}
+    call = _jitted_model_call(model, need_hidden)
     for s in range(0, n, bs):
-        out = model(input_ids=jnp.asarray(input_ids[s : s + bs]),
-                    attention_mask=jnp.asarray(attention_mask[s : s + bs]), **kwargs)
+        out = call(jnp.asarray(input_ids[s : s + bs]),
+                   jnp.asarray(attention_mask[s : s + bs]), **kwargs)
         if need_hidden:
             hidden = getattr(out, "hidden_states", None)
             if hidden is None:

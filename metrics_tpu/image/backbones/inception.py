@@ -226,12 +226,18 @@ class InceptionFeatureExtractor:
             self.variables = variables
         elif params is None:
             rng = jax.random.PRNGKey(0)
-            self.variables = self.model.init(rng, jnp.zeros((1, 299, 299, 3), jnp.float32))
+            # jit the init: eager Flax init dispatches hundreds of single ops
+            # (hundreds of tunnel round-trips on remote TPU — ~minutes); one
+            # compiled program initializes in seconds
+            self.variables = jax.jit(self.model.init)(rng, jnp.zeros((1, 299, 299, 3), jnp.float32))
         else:
             self.variables = {"params": params, **(batch_vars or {})}
+        # weights enter the jitted program as an ARGUMENT, not a closure:
+        # closure-captured variables lower as HLO constants (~90MB embedded
+        # program), which stalls compilation on remote TPU
         self._jitted = jax.jit(self._forward)
 
-    def _forward(self, imgs: Array) -> Array:
+    def _forward(self, variables: Dict, imgs: Array) -> Array:
         x = imgs.astype(jnp.float32)
         if self.fid_variant:
             x = tf1_resize_bilinear(x, 299, 299)
@@ -240,7 +246,7 @@ class InceptionFeatureExtractor:
             x = x / 255.0
             x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[-1]), method="bilinear")
             x = (x - 0.5) * 2.0
-        taps = self.model.apply(self.variables, x)
+        taps = self.model.apply(variables, x)
         return taps[self.feature]
 
     def __call__(self, imgs: Array) -> Array:
@@ -249,7 +255,7 @@ class InceptionFeatureExtractor:
             raise ValueError(f"Expected 4d image batch, got shape {imgs.shape}")
         if imgs.shape[1] == 3 and imgs.shape[-1] != 3:
             imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC (TPU layout)
-        return self._jitted(imgs)
+        return self._jitted(self.variables, imgs)
 
 
 def load_params_npz(path: str) -> Dict:

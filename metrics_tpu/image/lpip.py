@@ -130,14 +130,20 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
                 )
             module = _LpipsBackbone(net_type)
             if lpips_params is None:
-                variables = module.init(
+                # jitted init: one compiled program instead of per-op eager
+                # dispatches (minutes over a remote-TPU tunnel)
+                variables = jax.jit(module.init)(
                     jax.random.PRNGKey(0),
                     jnp.zeros((1, 64, 64, 3)),
                     jnp.zeros((1, 64, 64, 3)),
                 )
             else:
                 variables = {"params": lpips_params}
-            self._net = jax.jit(lambda a, b: module.apply(variables, a, b))
+            # variables as jit argument, not closure — closure-captured
+            # weights lower as embedded HLO constants and stall compilation
+            self._variables = variables
+            jitted = jax.jit(lambda v, a, b: module.apply(v, a, b))
+            self._net = lambda a, b: jitted(self._variables, a, b)
         else:
             self._net = net
         valid_reduction = ("mean", "sum")
