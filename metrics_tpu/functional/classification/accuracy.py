@@ -172,7 +172,15 @@ def accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Accuracy (functional; reference ``accuracy.py:accuracy``)."""
+    """Accuracy (functional; reference ``accuracy.py:accuracy``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> float(accuracy(preds, target, num_classes=4))
+        0.5
+    """
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

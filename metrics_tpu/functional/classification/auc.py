@@ -44,6 +44,14 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
-    """Area under the curve y(x) by the trapezoidal rule."""
+    """Area under the curve y(x) by the trapezoidal rule.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> float(auc(x, y))
+        4.0
+    """
     x, y = _auc_update(x, y)
     return _auc_compute(x, y, reorder=reorder)

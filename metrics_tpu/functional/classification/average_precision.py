@@ -98,7 +98,15 @@ def average_precision(
     average: Optional[str] = "macro",
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
-    """Area under the precision-recall step curve."""
+    """Area under the precision-recall step curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> float(average_precision(pred, target, pos_label=1))
+        1.0
+    """
     preds, target, num_classes, pos_label = _average_precision_update(
         preds, target, num_classes, pos_label, average
     )

@@ -78,7 +78,15 @@ def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
 
 
 def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
-    """Expected/max/RMS calibration error over equal-width confidence bins."""
+    """Expected/max/RMS calibration error over equal-width confidence bins.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> round(float(calibration_error(preds, target, n_bins=2, norm='l1')), 6)
+        0.29
+    """
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
     if not isinstance(n_bins, int) or n_bins <= 0:

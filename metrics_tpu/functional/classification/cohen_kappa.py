@@ -45,5 +45,14 @@ def cohen_kappa(
     threshold: float = 0.5,
     validate_args: bool = True,
 ) -> Array:
+    """Cohen kappa (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0.35, 0.85, 0.48, 0.01])
+        >>> float(cohen_kappa(preds, target, num_classes=2))
+        0.5
+    """
     confmat = _cohen_kappa_update(preds, target, num_classes, threshold, validate_args=validate_args)
     return _cohen_kappa_compute(confmat, weights)

@@ -75,5 +75,16 @@ def confusion_matrix(
     multilabel: bool = False,
     validate_args: bool = True,
 ) -> Array:
+    """Confusion matrix (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> np.asarray(confusion_matrix(preds, target, num_classes=2))
+        array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel, validate_args)
     return _confusion_matrix_compute(confmat, normalize)

@@ -78,6 +78,13 @@ def dice_score(
 
     Macro-averaged dice over classes, optionally skipping the background
     class 0 (``bg=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.asarray([[0.85, 0.05, 0.05, 0.05], [0.05, 0.85, 0.05, 0.05], [0.05, 0.05, 0.85, 0.05], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> float(dice_score(pred, target))
+        0.3333333432674408
     """
     import math
 

@@ -107,6 +107,15 @@ def f1_score(
     multiclass: Optional[bool] = None,
     validate_args: bool = True,
 ) -> Array:
+    """F1 score (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> round(float(f1_score(preds, target, num_classes=3)), 6)
+        0.333333
+    """
     return fbeta_score(
         preds, target, 1.0, average, mdmc_average, ignore_index, num_classes,
         threshold, top_k, multiclass, validate_args,

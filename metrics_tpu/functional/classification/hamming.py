@@ -31,5 +31,14 @@ def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array
 def hamming_distance(
     preds: Array, target: Array, threshold: float = 0.5, validate_args: bool = True
 ) -> Array:
+    """Hamming distance (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> float(hamming_distance(preds, target))
+        0.25
+    """
     correct, total = _hamming_distance_update(preds, target, threshold, validate_args)
     return _hamming_distance_compute(correct, total)

@@ -92,6 +92,14 @@ def hinge_loss(
     squared: bool = False,
     multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
 ) -> Array:
-    """Mean hinge loss (Crammer-Singer or one-vs-all for multiclass)."""
+    """Mean hinge loss (Crammer-Singer or one-vs-all for multiclass).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> round(float(hinge_loss(preds, target)), 6)
+        0.3
+    """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

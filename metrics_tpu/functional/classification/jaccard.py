@@ -63,6 +63,15 @@ def jaccard_index(
     multilabel: bool = False,
     validate_args: bool = True,
 ) -> Array:
+    """Jaccard index (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([[0, 1, 0], [1, 1, 0]])
+        >>> preds = jnp.asarray([[0, 1, 0], [0, 1, 1]])
+        >>> round(float(jaccard_index(preds, target, num_classes=2)), 6)
+        0.5
+    """
     confmat = _jaccard_index_update(
         preds, target, num_classes, threshold, multilabel, validate_args=validate_args
     )

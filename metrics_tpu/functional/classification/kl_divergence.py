@@ -41,6 +41,14 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
 def kl_divergence(
     p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean"
 ) -> Array:
-    """KL(P||Q) between distributions over the last dim."""
+    """KL(P||Q) between distributions over the last dim.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> round(float(kl_divergence(p, q)), 6)
+        0.0853
+    """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, total, reduction)

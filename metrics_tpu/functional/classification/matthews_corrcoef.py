@@ -32,5 +32,14 @@ def matthews_corrcoef(
     threshold: float = 0.5,
     validate_args: bool = True,
 ) -> Array:
+    """Matthews corrcoef (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> round(float(matthews_corrcoef(preds, target, num_classes=2)), 6)
+        0.57735
+    """
     confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold, validate_args=validate_args)
     return _matthews_corrcoef_compute(confmat)

@@ -106,6 +106,15 @@ def precision(
     multiclass: Optional[bool] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Precision (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(precision(preds, target, average='macro', num_classes=3)), 6)
+        0.166667
+    """
     _check_avg_arg(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, _, fn = _stat_scores_update(
@@ -128,6 +137,15 @@ def recall(
     multiclass: Optional[bool] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Recall (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(recall(preds, target, average='macro', num_classes=3)), 6)
+        0.333333
+    """
     _check_avg_arg(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, _, fn = _stat_scores_update(

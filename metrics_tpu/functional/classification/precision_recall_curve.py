@@ -164,7 +164,17 @@ def precision_recall_curve(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ):
-    """precision, recall, thresholds at every distinct score."""
+    """precision, recall, thresholds at every distinct score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> np.asarray(precision)
+        array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+    """
     preds, target, num_classes, pos_label = _precision_recall_curve_update(
         preds, target, num_classes, pos_label
     )

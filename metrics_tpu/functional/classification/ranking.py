@@ -55,7 +55,15 @@ def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Opt
 
 
 def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
-    """Average number of top-ranked labels needed to cover all true labels."""
+    """Average number of top-ranked labels needed to cover all true labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> round(float(coverage_error(preds, target)), 6)
+        1.333333
+    """
     coverage, n_elements, sample_weight = _coverage_error_update(preds, target, sample_weight)
     return _coverage_error_compute(coverage, n_elements, sample_weight)
 
@@ -131,6 +139,14 @@ def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Opt
 
 
 def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
-    """Average fraction of incorrectly ordered (relevant, irrelevant) label pairs."""
+    """Average fraction of incorrectly ordered (relevant, irrelevant) label pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> round(float(label_ranking_loss(preds, target)), 6)
+        0.0
+    """
     loss, n, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
     return _label_ranking_loss_compute(loss, n, sample_weight)

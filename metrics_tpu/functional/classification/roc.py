@@ -84,6 +84,17 @@ def roc(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ):
-    """fpr, tpr, thresholds (per class for multiclass/multilabel)."""
+    """fpr, tpr, thresholds (per class for multiclass/multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
+        >>> np.asarray(tpr)
+        array([0.        , 0.33333334, 0.6666667 , 1.        , 1.        ],
+              dtype=float32)
+    """
     preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
     return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -50,6 +50,15 @@ def specificity(
     multiclass: Optional[bool] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Specificity (functional).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(specificity(preds, target, average='macro', num_classes=3)), 6)
+        0.611111
+    """
     _check_avg_arg(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(

@@ -215,7 +215,16 @@ def stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Public functional: stacked [tp, fp, tn, fn, support] counts."""
+    """Public functional: stacked [tp, fp, tn, fn, support] counts.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> preds = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> np.asarray(stat_scores(preds, target, reduce='micro'))
+        array([2, 2, 6, 2, 4], dtype=int32)
+    """
     if reduce not in ("micro", "macro", "samples"):
         raise ValueError(f"The `reduce` {reduce} is not valid.")
     if mdmc_reduce not in (None, "samplewise", "global"):

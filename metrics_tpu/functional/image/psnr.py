@@ -62,8 +62,8 @@ def peak_signal_noise_ratio(
         >>> import jax.numpy as jnp
         >>> pred = jnp.array([[0.0, 1.0], [2.0, 3.0]])
         >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
-        >>> peak_signal_noise_ratio(pred, target)
-        Array(2.5527418, dtype=float32)
+        >>> round(float(peak_signal_noise_ratio(pred, target)), 4)
+        2.5527
     """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")

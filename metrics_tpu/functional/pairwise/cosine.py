@@ -26,6 +26,17 @@ def pairwise_cosine_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """[N,M] cosine similarity matrix between rows of x and y (default y = x)."""
+    """[N,M] cosine similarity matrix between rows of x and y (default y = x).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> np.round(np.asarray(pairwise_cosine_similarity(x, y)), 4)
+        array([[0.5547, 0.8682],
+               [0.5145, 0.8437],
+               [0.53  , 0.8533]], dtype=float32)
+    """
     distance = _pairwise_cosine_similarity_compute(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

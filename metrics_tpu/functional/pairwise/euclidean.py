@@ -29,6 +29,17 @@ def pairwise_euclidean_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """[N,M] euclidean distance matrix between rows of x and y (default y = x)."""
+    """[N,M] euclidean distance matrix between rows of x and y (default y = x).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> np.round(np.asarray(pairwise_euclidean_distance(x, y)), 4)
+        array([[3.1623, 2.    ],
+               [5.3852, 4.1231],
+               [8.9443, 7.6158]], dtype=float32)
+    """
     distance = _pairwise_euclidean_distance_compute(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

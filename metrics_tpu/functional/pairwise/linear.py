@@ -23,6 +23,17 @@ def pairwise_linear_similarity(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """[N,M] dot-product similarity matrix between rows of x and y (default y = x)."""
+    """[N,M] dot-product similarity matrix between rows of x and y (default y = x).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> np.asarray(pairwise_linear_similarity(x, y))
+        array([[ 2.,  7.],
+               [ 3., 11.],
+               [ 5., 18.]], dtype=float32)
+    """
     distance = _pairwise_linear_similarity_compute(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -24,6 +24,17 @@ def pairwise_manhattan_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """[N,M] L1 distance matrix between rows of x and y (default y = x)."""
+    """[N,M] L1 distance matrix between rows of x and y (default y = x).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> np.asarray(pairwise_manhattan_distance(x, y))
+        array([[ 4.,  2.],
+               [ 7.,  5.],
+               [12., 10.]], dtype=float32)
+    """
     distance = _pairwise_manhattan_distance_compute(x, y, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)

@@ -33,6 +33,14 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    """Row-wise cosine similarity with final reduction."""
+    """Row-wise cosine similarity with final reduction.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([[1.0, 2.0, 3.0, 4.0], [1.0, 2.0, 3.0, 4.0]])
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0, 4.0], [-1.0, -2.0, -3.0, -4.0]])
+        >>> round(float(cosine_similarity(preds, target, reduction='mean')), 6)
+        0.0
+    """
     preds, target = _cosine_similarity_update(jnp.asarray(preds), jnp.asarray(target))
     return _cosine_similarity_compute(preds, target, reduction)

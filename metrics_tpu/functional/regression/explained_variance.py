@@ -58,6 +58,14 @@ def _explained_variance_compute(
 
 
 def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
-    """Explained variance regression score."""
+    """Explained variance regression score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(explained_variance(preds, target)), 6)
+        0.957173
+    """
     stats = _explained_variance_update(jnp.asarray(preds), jnp.asarray(target))
     return _explained_variance_compute(*stats, multioutput=multioutput)

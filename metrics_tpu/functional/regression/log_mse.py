@@ -23,6 +23,14 @@ def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs: Array) 
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """MSLE: mean((log(1+p) - log(1+t))^2)."""
+    """MSLE: mean((log(1+p) - log(1+t))^2).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> round(float(mean_squared_log_error(preds, target)), 6)
+        0.03973
+    """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(jnp.asarray(preds), jnp.asarray(target))
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

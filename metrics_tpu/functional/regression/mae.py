@@ -22,6 +22,14 @@ def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Array) -> Array:
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """MAE over all elements."""
+    """MAE over all elements.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> float(mean_absolute_error(preds, target))
+        0.5
+    """
     sum_abs_error, n_obs = _mean_absolute_error_update(jnp.asarray(preds), jnp.asarray(target))
     return _mean_absolute_error_compute(sum_abs_error, n_obs)

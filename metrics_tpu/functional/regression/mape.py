@@ -27,7 +27,15 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, n_obs: Arr
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """MAPE: mean(|p - t| / max(|t|, eps))."""
+    """MAPE: mean(|p - t| / max(|t|, eps)).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([1.0, 10.0, 1e6])
+        >>> preds = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> round(float(mean_absolute_percentage_error(preds, target)), 6)
+        0.266667
+    """
     sum_abs_per_error, n_obs = _mean_absolute_percentage_error_update(
         jnp.asarray(preds), jnp.asarray(target)
     )

@@ -52,7 +52,15 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson correlation coefficient between two 1D arrays."""
+    """Pearson correlation coefficient between two 1D arrays.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(pearson_corrcoef(preds, target)), 6)
+        0.98487
+    """
     zero = jnp.zeros((), dtype=jnp.float32)
     _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
         jnp.asarray(preds), jnp.asarray(target), zero, zero, zero, zero, zero, zero

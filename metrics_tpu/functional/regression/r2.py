@@ -70,6 +70,14 @@ def _r2_score_compute(
 def r2_score(
     preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average"
 ) -> Array:
-    """R² (coefficient of determination), optionally adjusted."""
+    """R² (coefficient of determination), optionally adjusted.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(r2_score(preds, target)), 6)
+        0.948608
+    """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(jnp.asarray(preds), jnp.asarray(target))
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)

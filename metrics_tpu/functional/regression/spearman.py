@@ -49,6 +49,14 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman correlation: pearson on fractional ranks."""
+    """Spearman correlation: pearson on fractional ranks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(spearman_corrcoef(preds, target)), 6)
+        0.999999
+    """
     preds, target = _spearman_corrcoef_update(jnp.asarray(preds), jnp.asarray(target))
     return _spearman_corrcoef_compute(preds, target)

@@ -29,7 +29,15 @@ def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, 
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """sMAPE: mean(2|p - t| / max(|t| + |p|, eps))."""
+    """sMAPE: mean(2|p - t| / max(|t| + |p|, eps)).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([1.0, 10.0, 1e6])
+        >>> preds = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> round(float(symmetric_mean_absolute_percentage_error(preds, target)), 6)
+        0.229027
+    """
     sum_abs_per_error, n_obs = _symmetric_mean_absolute_percentage_error_update(
         jnp.asarray(preds), jnp.asarray(target)
     )

@@ -59,7 +59,15 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
-    """Mean Tweedie deviance for the given power (0=Normal, 1=Poisson, 2=Gamma)."""
+    """Mean Tweedie deviance for the given power (0=Normal, 1=Poisson, 2=Gamma).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> targets = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([4.0, 3.0, 2.0, 1.0])
+        >>> float(tweedie_deviance_score(preds, targets, power=0))
+        5.0
+    """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(
         jnp.asarray(preds), jnp.asarray(targets), power
     )

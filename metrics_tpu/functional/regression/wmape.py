@@ -26,7 +26,15 @@ def _weighted_mean_absolute_percentage_error_compute(
 
 
 def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """WMAPE: sum(|p - t|) / sum(|t|)."""
+    """WMAPE: sum(|p - t|) / sum(|t|).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([1.0, 10.0, 1e6])
+        >>> preds = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> round(float(weighted_mean_absolute_percentage_error(preds, target)), 6)
+        0.200003
+    """
     sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(
         jnp.asarray(preds), jnp.asarray(target)
     )

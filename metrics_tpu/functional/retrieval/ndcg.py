@@ -23,8 +23,8 @@ def retrieval_normalized_dcg(
 
     Example:
         >>> import jax.numpy as jnp
-        >>> retrieval_normalized_dcg(jnp.array([.1, .2, .3, 4., 70.]), jnp.array([10, 0, 0, 1, 5]))
-        Array(0.69569725, dtype=float32)
+        >>> round(float(retrieval_normalized_dcg(jnp.array([.1, .2, .3, 4., 70.]), jnp.array([10, 0, 0, 1, 5]))), 4)
+        0.6957
     """
     if k is not None and not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")

@@ -124,12 +124,11 @@ def _check_classification_inputs(
     if float(jnp.min(target)) < 0:
         if ignore_index is None or float(jnp.min(jnp.where(target == ignore_index, 0, target))) < 0:
             raise ValueError("target values must be non-negative")
-    if _is_floating(preds):
-        pmin, pmax = float(jnp.min(preds)), float(jnp.max(preds))
-        if pmin < 0.0 or pmax > 1.0:
-            raise ValueError(
-                "preds should be probabilities in [0, 1]; apply jax.nn.softmax/sigmoid to logits first."
-            )
+    # float preds outside [0, 1] are accepted as logits and thresholded /
+    # argmaxed directly, matching the reference contract ("probabilities,
+    # logits or labels", reference ``utilities/checks.py:455-500`` — its
+    # ``_input_format_classification`` applies ``preds >= threshold`` with no
+    # range validation)
     if not 0.0 < threshold < 1.0:
         raise ValueError(f"threshold must be in (0, 1), got {threshold}")
     case = _classify_case(preds, target, multiclass)

@@ -61,3 +61,28 @@ _multidim_multiclass_inputs = Input(
     preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
     target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
 )
+
+
+_binary_logits_inputs = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+_multilabel_logits_inputs = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+_multilabel_multidim_prob_inputs = Input(
+    preds=_prob(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+_multilabel_multidim_inputs = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+# nothing matches: every score is undefined-edge territory (reference inputs.py:64-68)
+__no_match_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+_multilabel_no_match_inputs = Input(preds=__no_match_preds, target=1 - __no_match_preds)

@@ -9,12 +9,17 @@ from metrics_tpu.functional.classification.accuracy import accuracy
 
 from tests.classification.inputs import (
     _binary_inputs,
+    _binary_logits_inputs,
     _binary_prob_inputs,
     _multiclass_inputs,
     _multiclass_prob_inputs,
     _multidim_multiclass_inputs,
     _multidim_multiclass_prob_inputs,
     _multilabel_inputs,
+    _multilabel_logits_inputs,
+    _multilabel_multidim_inputs,
+    _multilabel_multidim_prob_inputs,
+    _multilabel_no_match_inputs,
     _multilabel_prob_inputs,
 )
 from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
@@ -47,9 +52,14 @@ class TestAccuracy(MetricTester):
         [
             (_binary_prob_inputs.preds, _binary_prob_inputs.target, False),
             (_binary_inputs.preds, _binary_inputs.target, False),
+            (_binary_logits_inputs.preds, _binary_logits_inputs.target, False),
             (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, False),
             (_multilabel_prob_inputs.preds, _multilabel_prob_inputs.target, True),
             (_multilabel_inputs.preds, _multilabel_inputs.target, False),
+            (_multilabel_logits_inputs.preds, _multilabel_logits_inputs.target, False),
+            (_multilabel_no_match_inputs.preds, _multilabel_no_match_inputs.target, False),
+            (_multilabel_multidim_prob_inputs.preds, _multilabel_multidim_prob_inputs.target, False),
+            (_multilabel_multidim_inputs.preds, _multilabel_multidim_inputs.target, False),
             (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, False),
             (_multiclass_inputs.preds, _multiclass_inputs.target, False),
             (_multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target, False),

@@ -1,28 +1,41 @@
 """Docstring examples as tests (the reference enables ``doctest_plus`` so
-every docstring example runs in CI — ``setup.cfg:1-24``)."""
+every docstring example runs in CI — ``setup.cfg:1-24``).
+
+Auto-discovers every module under ``metrics_tpu.functional``: all doctests
+must pass, and every user-facing module must carry at least one example.
+Internal helper modules and optional-dependency gates are exempt from the
+must-have-examples requirement (but still run whatever they have).
+"""
 
 import doctest
 import importlib
+import pkgutil
 
 import pytest
 
-MODULES = [
-    "metrics_tpu.functional.text.wer",
-    "metrics_tpu.functional.text.cer",
-    "metrics_tpu.functional.text.mer",
-    "metrics_tpu.functional.text.wil",
-    "metrics_tpu.functional.text.wip",
-    "metrics_tpu.functional.text.bleu",
-    "metrics_tpu.functional.text.sacre_bleu",
-    "metrics_tpu.functional.text.chrf",
-    "metrics_tpu.functional.text.ter",
-    "metrics_tpu.functional.text.eed",
-    "metrics_tpu.functional.text.rouge",
-    "metrics_tpu.functional.text.squad",
-    "metrics_tpu.functional.audio.snr",
-    "metrics_tpu.functional.audio.sdr",
-    "metrics_tpu.functional.audio.pit",
-]
+import metrics_tpu.functional as _functional
+
+
+def _discover():
+    return sorted(
+        m.name
+        for m in pkgutil.walk_packages(_functional.__path__, prefix="metrics_tpu.functional.")
+        if not m.ispkg
+    )
+
+
+MODULES = _discover()
+
+# internal engines/helpers and optional-dependency gates: doctests optional
+EXAMPLES_OPTIONAL = {
+    "metrics_tpu.functional.audio.pesq",  # gated extra, like the reference
+    "metrics_tpu.functional.audio.stoi",  # gated extra
+    "metrics_tpu.functional.image.helper",
+    "metrics_tpu.functional.pairwise.helpers",
+    "metrics_tpu.functional.retrieval.engine",
+    "metrics_tpu.functional.text.bert",  # needs a model instance
+    "metrics_tpu.functional.text.helper",
+}
 
 
 @pytest.mark.parametrize("module_name", MODULES)
@@ -32,4 +45,10 @@ def test_doctests(module_name):
         module, optionflags=doctest.ELLIPSIS | doctest.NORMALIZE_WHITESPACE, verbose=False
     )
     assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"
-    assert results.attempted > 0, f"no doctests found in {module_name}"
+    if module_name not in EXAMPLES_OPTIONAL:
+        assert results.attempted > 0, f"no doctests found in {module_name}"
+
+
+def test_discovery_is_broad():
+    # regression guard: the sweep must keep covering the whole functional layer
+    assert len(MODULES) >= 70
