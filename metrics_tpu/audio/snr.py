@@ -12,6 +12,18 @@ Array = jax.Array
 
 
 class SignalNoiseRatio(Metric):
+    """``SignalNoiseRatio`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        16.1805
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

@@ -28,6 +28,16 @@ class Accuracy(StatScores):
     Supports micro/macro/weighted/none/samples averaging, multi-dim
     multi-class global/samplewise handling, top-k, and subset accuracy — the
     full surface of the reference class.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> metric = Accuracy(num_classes=4)
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        0.5
     """
 
     is_differentiable = False

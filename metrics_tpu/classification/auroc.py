@@ -12,6 +12,18 @@ Array = jax.Array
 
 
 class AUROC(Metric):
+    """``AUROC`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> metric = AUROC(pos_label=1)
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        0.5
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -15,6 +15,18 @@ Array = jax.Array
 
 
 class AveragePrecision(Metric):
+    """``AveragePrecision`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> metric = AveragePrecision(pos_label=1)
+        >>> metric.update(pred, target)
+        >>> float(metric.compute())
+        1.0
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

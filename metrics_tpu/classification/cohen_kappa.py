@@ -16,7 +16,18 @@ Array = jax.Array
 
 
 class CohenKappa(Metric):
-    """Cohen's kappa inter-annotator agreement over a streamed confusion matrix."""
+    """Cohen's kappa inter-annotator agreement over a streamed confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CohenKappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0.35, 0.85, 0.48, 0.01])
+        >>> metric = CohenKappa(num_classes=2)
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -17,7 +17,20 @@ Array = jax.Array
 
 class ConfusionMatrix(Metric):
     """Streaming (C, C) confusion counts — the shared state of the
-    CohenKappa / JaccardIndex / MatthewsCorrCoef compute group."""
+    CohenKappa / JaccardIndex / MatthewsCorrCoef compute group.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> metric = ConfusionMatrix(num_classes=2)
+        >>> metric.update(preds, target)
+        >>> np.asarray(metric.compute())
+        array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
 
     is_differentiable = False
     higher_is_better = None

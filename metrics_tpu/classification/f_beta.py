@@ -26,7 +26,18 @@ class FBetaScore(_PrecisionRecallBase):
 
 
 class F1Score(FBetaScore):
-    """F-beta with beta=1 (reference ``f_beta.py:163``)."""
+    """F-beta with beta=1 (reference ``f_beta.py:163``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import F1Score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> metric = F1Score(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 6)
+        0.333333
+    """
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(beta=1.0, **kwargs)

@@ -16,7 +16,18 @@ Array = jax.Array
 
 
 class MatthewsCorrCoef(Metric):
-    """Matthews correlation coefficient over a streamed confusion matrix."""
+    """Matthews correlation coefficient over a streamed confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> metric = MatthewsCorrCoef(num_classes=2)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 6)
+        0.57735
+    """
 
     is_differentiable = False
     higher_is_better = True

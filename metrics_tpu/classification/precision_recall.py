@@ -49,7 +49,18 @@ class _PrecisionRecallBase(StatScores):
 
 
 class Precision(_PrecisionRecallBase):
-    """Precision = tp / (tp + fp) (reference ``precision_recall.py:23``)."""
+    """Precision = tp / (tp + fp) (reference ``precision_recall.py:23``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> metric = Precision(average='macro', num_classes=3)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 6)
+        0.166667
+    """
 
     def compute(self) -> Array:
         tp, fp, _, fn = self._get_final_stats()

@@ -34,6 +34,17 @@ class StatScores(Metric):
     therefore not caught on the switching batch; detection re-runs every
     ``_REDETECT_EVERY`` skipped batches, so a sustained switch still raises.
     With ``validate_args=True`` (default) every batch is inspected.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import StatScores
+        >>> preds = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> metric = StatScores(reduce='micro')
+        >>> metric.update(preds, target)
+        >>> np.asarray(metric.compute())
+        array([2, 2, 6, 2, 4], dtype=int32)
     """
 
     is_differentiable = False

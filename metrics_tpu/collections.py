@@ -34,6 +34,17 @@ class MetricCollection:
         compute_groups: auto-detect metrics with identical states and update
             only one representative per group (True by default), or an explicit
             list of name-groups.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricCollection, Precision
+        >>> target = jnp.asarray([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.asarray([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection({'acc': Accuracy(num_classes=3), 'prec': Precision(num_classes=3, average='macro')})
+        >>> metrics.update(preds, target)
+        >>> out = metrics.compute()
+        >>> sorted(out)
+        ['acc', 'prec']
     """
 
     def __init__(

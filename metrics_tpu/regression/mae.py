@@ -12,6 +12,18 @@ Array = jax.Array
 
 
 class MeanAbsoluteError(Metric):
+    """``MeanAbsoluteError`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        0.5
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

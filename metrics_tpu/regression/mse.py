@@ -12,6 +12,18 @@ Array = jax.Array
 
 
 class MeanSquaredError(Metric):
+    """``MeanSquaredError`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> metric = MeanSquaredError()
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        0.875
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

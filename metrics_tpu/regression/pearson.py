@@ -45,6 +45,18 @@ def _final_aggregation(
 
 
 class PearsonCorrCoef(Metric):
+    """``PearsonCorrCoef`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 6)
+        0.98487
+    """
     is_differentiable = True
     higher_is_better = None
     full_state_update = True

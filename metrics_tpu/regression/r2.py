@@ -12,6 +12,18 @@ Array = jax.Array
 
 
 class R2Score(Metric):
+    """``R2Score`` module metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = R2Score()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 6)
+        0.948608
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

@@ -14,7 +14,18 @@ Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
-    """Rank correlation needs the full sample — buffered device states, gather-synced."""
+    """Rank correlation needs the full sample — buffered device states, gather-synced.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 6)
+        0.999999
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -11,7 +11,19 @@ Array = jax.Array
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean Reciprocal Rank over queries."""
+    """Mean Reciprocal Rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMRR()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> float(metric.compute())
+        1.0
+    """
 
     def _group_scores(self, preds, target, group, n_groups) -> Tuple[Array, Array]:
         scores = reciprocal_rank_per_group(preds, target, group, n_groups)

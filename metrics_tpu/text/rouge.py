@@ -21,6 +21,16 @@ class ROUGEScore(Metric):
 
     The reference appends per-sentence scores to list states; averaging on the
     fly keeps every state a sum-reducible scalar.
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> preds = ['the cat sat on the mat']
+        >>> target = ['a cat sat on the mat']
+        >>> metric = ROUGEScore(rouge_keys=('rouge1',))
+        >>> metric.update(preds, target)
+        >>> out = metric.compute()
+        >>> round(float(out['rouge1_fmeasure']), 6)
+        0.833333
     """
 
     is_differentiable = False

@@ -189,3 +189,49 @@ def test_precision_none_returns_per_class(average):
     assert res.shape == (NUM_CLASSES,)
     sk = sk_precision(np.asarray(MC.target[0]), np.asarray(MC.preds[0]).argmax(-1), average=None, zero_division=0)
     np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+
+
+class TestExtraInputRegimes(MetricTester):
+    """Logits / multilabel-multidim / no-match regimes through the
+    stat-scores family (reference inputs.py:25-68 breadth)."""
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize(
+        "metric_class, sk_fn",
+        [(Precision, sk_precision), (Recall, sk_recall)],
+    )
+    def test_binary_logits(self, ddp, metric_class, sk_fn):
+        from tests.classification.inputs import _binary_logits_inputs as IN
+
+        self.run_class_metric_test(
+            preds=IN.preds,
+            target=IN.target,
+            metric_class=metric_class,
+            reference_fn=_sk_wrapper(sk_fn, "binary"),
+            metric_args={},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize(
+        "metric_class, sk_fn",
+        [(Precision, sk_precision), (Recall, sk_recall)],
+    )
+    def test_multilabel_logits(self, metric_class, sk_fn):
+        from tests.classification.inputs import _multilabel_logits_inputs as IN
+
+        self.run_class_metric_test(
+            preds=IN.preds,
+            target=IN.target,
+            metric_class=metric_class,
+            reference_fn=_sk_wrapper(sk_fn, "micro"),
+            metric_args={"average": "micro"},
+        )
+
+    def test_multilabel_no_match_is_zero(self):
+        from metrics_tpu.classification import F1Score
+        from tests.classification.inputs import _multilabel_no_match_inputs as IN
+
+        m = F1Score()
+        for i in range(IN.preds.shape[0]):
+            m.update(jnp.asarray(IN.preds[i]), jnp.asarray(IN.target[i]))
+        assert float(m.compute()) == 0.0

@@ -38,17 +38,49 @@ EXAMPLES_OPTIONAL = {
 }
 
 
-@pytest.mark.parametrize("module_name", MODULES)
-def test_doctests(module_name):
+def _run_doctests(module_name: str, require_examples: bool) -> None:
     module = importlib.import_module(module_name)
     results = doctest.testmod(
         module, optionflags=doctest.ELLIPSIS | doctest.NORMALIZE_WHITESPACE, verbose=False
     )
     assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"
-    if module_name not in EXAMPLES_OPTIONAL:
+    if require_examples:
         assert results.attempted > 0, f"no doctests found in {module_name}"
+
+
+@pytest.mark.parametrize("module_name", MODULES)
+def test_doctests(module_name):
+    _run_doctests(module_name, require_examples=module_name not in EXAMPLES_OPTIONAL)
 
 
 def test_discovery_is_broad():
     # regression guard: the sweep must keep covering the whole functional layer
     assert len(MODULES) >= 70
+
+
+MODULE_CLASS_MODULES = [
+    "metrics_tpu.aggregation",
+    "metrics_tpu.collections",
+    "metrics_tpu.audio.snr",
+    "metrics_tpu.classification.accuracy",
+    "metrics_tpu.classification.auroc",
+    "metrics_tpu.classification.avg_precision",
+    "metrics_tpu.classification.cohen_kappa",
+    "metrics_tpu.classification.confusion_matrix",
+    "metrics_tpu.classification.f_beta",
+    "metrics_tpu.classification.matthews_corrcoef",
+    "metrics_tpu.classification.precision_recall",
+    "metrics_tpu.classification.stat_scores",
+    "metrics_tpu.regression.mae",
+    "metrics_tpu.regression.mse",
+    "metrics_tpu.regression.pearson",
+    "metrics_tpu.regression.r2",
+    "metrics_tpu.regression.spearman",
+    "metrics_tpu.retrieval.reciprocal_rank",
+    "metrics_tpu.text.rouge",
+]
+
+
+@pytest.mark.parametrize("module_name", MODULE_CLASS_MODULES)
+def test_module_class_doctests(module_name):
+    _run_doctests(module_name, require_examples=True)
