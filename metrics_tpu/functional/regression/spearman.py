@@ -53,10 +53,10 @@ def spearman_corrcoef(preds: Array, target: Array) -> Array:
 
     Example:
         >>> import jax.numpy as jnp
-        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
-        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
-        >>> round(float(spearman_corrcoef(preds, target)), 6)
-        0.999999
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0, 4.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0, 1.0])
+        >>> round(float(spearman_corrcoef(preds, target)), 4)
+        0.7
     """
     preds, target = _spearman_corrcoef_update(jnp.asarray(preds), jnp.asarray(target))
     return _spearman_corrcoef_compute(preds, target)

@@ -19,12 +19,12 @@ class SpearmanCorrCoef(Metric):
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import SpearmanCorrCoef
-        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
-        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0, 4.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0, 1.0])
         >>> metric = SpearmanCorrCoef()
         >>> metric.update(preds, target)
-        >>> round(float(metric.compute()), 6)
-        0.999999
+        >>> round(float(metric.compute()), 4)
+        0.7
     """
 
     is_differentiable = False

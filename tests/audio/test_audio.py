@@ -311,3 +311,62 @@ class TestLapPit:
         cost[0, 1, 2] = np.nan
         with pytest.raises(ValueError, match="non-finite"):
             lap_batch(cost)
+
+
+class TestAudioEdgeRegimes:
+    """Edge shapes/values for the audio family (reference exercises multi-dim
+    batches and degenerate signals across its per-metric files)."""
+
+    def test_snr_perfect_reconstruction_is_huge(self):
+        from metrics_tpu.functional import signal_noise_ratio
+
+        # eps-guarded like the reference: perfect reconstruction gives a
+        # large finite dB value, not inf
+        x = jnp.asarray(np.random.default_rng(0).normal(size=64).astype(np.float32))
+        v = float(signal_noise_ratio(x, x))
+        assert np.isfinite(v) and v > 50.0
+
+    def test_si_snr_scale_invariance(self):
+        from metrics_tpu.functional import scale_invariant_signal_noise_ratio
+
+        rng = np.random.default_rng(1)
+        tgt = jnp.asarray(rng.normal(size=128).astype(np.float32))
+        noisy = tgt + 0.1 * jnp.asarray(rng.normal(size=128).astype(np.float32))
+        a = float(scale_invariant_signal_noise_ratio(noisy, tgt))
+        b = float(scale_invariant_signal_noise_ratio(3.7 * noisy, tgt))
+        assert np.isclose(a, b, atol=1e-3)
+
+    def test_multidim_batch_shapes(self):
+        from metrics_tpu import SignalNoiseRatio
+
+        rng = np.random.default_rng(2)
+        tgt = jnp.asarray(rng.normal(size=(2, 3, 64)).astype(np.float32))
+        pred = tgt + 0.05 * jnp.asarray(rng.normal(size=(2, 3, 64)).astype(np.float32))
+        m = SignalNoiseRatio()
+        m.update(pred, tgt)
+        v = float(m.compute())
+        assert np.isfinite(v) and v > 10
+
+    def test_pit_single_speaker(self):
+        from metrics_tpu.functional import permutation_invariant_training, scale_invariant_signal_noise_ratio
+
+        rng = np.random.default_rng(3)
+        pred = jnp.asarray(rng.normal(size=(2, 1, 64)).astype(np.float32))
+        tgt = jnp.asarray(rng.normal(size=(2, 1, 64)).astype(np.float32))
+        best, perm = permutation_invariant_training(
+            pred, tgt, scale_invariant_signal_noise_ratio, eval_func="max"
+        )
+        assert perm.shape == (2, 1) and np.all(np.asarray(perm) == 0)
+
+    def test_sdr_batch_matches_single(self):
+        from metrics_tpu.functional import signal_distortion_ratio
+
+        rng = np.random.default_rng(4)
+        tgt = rng.normal(size=(3, 128)).astype(np.float32)
+        pred = tgt + 0.1 * rng.normal(size=(3, 128)).astype(np.float32)
+        batch = np.asarray(signal_distortion_ratio(jnp.asarray(pred), jnp.asarray(tgt)))
+        singles = [
+            float(signal_distortion_ratio(jnp.asarray(pred[i]), jnp.asarray(tgt[i])))
+            for i in range(3)
+        ]
+        np.testing.assert_allclose(batch, singles, atol=1e-3)

@@ -332,3 +332,37 @@ def test_image_gradients():
     np.testing.assert_allclose(np.asarray(dx[0, 0, :, :4]), np.full((5, 4), 1.0))
     with pytest.raises(RuntimeError, match="4D"):
         image_gradients(jnp.ones((5, 5)))
+
+
+class TestImageEdgeRegimes:
+    """Edge shapes/values across the analytic image metrics."""
+
+    def test_psnr_identical_images_is_inf(self):
+        a = jnp.asarray(np.random.default_rng(0).random((2, 3, 16, 16), dtype=np.float32))
+        assert np.isinf(float(peak_signal_noise_ratio(a, a, data_range=1.0)))
+
+    def test_ssim_identical_images_is_one(self):
+        a = jnp.asarray(np.random.default_rng(1).random((2, 3, 32, 32), dtype=np.float32))
+        assert np.isclose(float(structural_similarity_index_measure(a, a, data_range=1.0)), 1.0, atol=1e-5)
+
+    def test_ssim_anticorrelated_below_uncorrelated(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((1, 1, 32, 32)).astype(np.float32)
+        inverted = 1.0 - a
+        noise = rng.random((1, 1, 32, 32)).astype(np.float32)
+        s_inv = float(structural_similarity_index_measure(jnp.asarray(inverted), jnp.asarray(a), data_range=1.0))
+        s_noise = float(structural_similarity_index_measure(jnp.asarray(noise), jnp.asarray(a), data_range=1.0))
+        assert s_inv < s_noise < 1.0
+
+    def test_psnr_uint8_range_255(self):
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 256, (1, 3, 16, 16)).astype(np.uint8)
+        b = np.clip(a.astype(np.int32) + rng.integers(-10, 10, a.shape), 0, 255).astype(np.uint8)
+        v = float(peak_signal_noise_ratio(jnp.asarray(a), jnp.asarray(b), data_range=255.0))
+        assert 20 < v < 60
+
+    def test_single_image_no_batch_dim_raises_or_handles(self):
+        a = jnp.asarray(np.random.default_rng(4).random((3, 16, 16), dtype=np.float32))
+        # PSNR is shape-agnostic elementwise — must accept unbatched input
+        v = float(peak_signal_noise_ratio(a, a * 0.9, data_range=1.0))
+        assert np.isfinite(v)

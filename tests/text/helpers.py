@@ -69,9 +69,17 @@ class TextTester:
         m1 = metric_class(**metric_args)
         for i in range(n):
             (m0 if i % 2 == 0 else m1).update(preds_batches[i], target_batches[i])
-        m0.merge_state(m1._state)
-        m0._update_count += m1._update_count
+        m0.merge_state(m1._state, other_count=m1.update_count)
         _assert_close(m0.compute(), ref_total, atol)
+
+        # forward: each call returns the metric on THAT batch alone, and the
+        # accumulated epoch value still matches the all-data oracle
+        # (reference TextTester checks forward batch values the same way)
+        mf = metric_class(**metric_args)
+        for p, t in zip(preds_batches, target_batches):
+            batch_val = mf(p, t)
+            _assert_close(batch_val, reference_fn(list(p), list(t)), atol)
+        _assert_close(mf.compute(), ref_total, atol)
 
     def run_text_functional_test(
         self,

@@ -1,0 +1,74 @@
+"""Edge input regimes for the text metrics: empty strings, unicode,
+pred==target identity, and single-string (non-list) inputs — the regimes the
+reference exercises across its per-metric test files
+(reference ``tests/unittests/text/test_wer.py`` etc.)."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu import BLEUScore, CharErrorRate, ROUGEScore, WordErrorRate
+from metrics_tpu.functional import char_error_rate, word_error_rate
+
+
+class TestIdentity:
+    """pred == target must give a perfect score."""
+
+    def test_wer_zero(self):
+        assert float(word_error_rate(["hello world"], ["hello world"])) == 0.0
+
+    def test_cer_zero(self):
+        assert float(char_error_rate(["hello"], ["hello"])) == 0.0
+
+    def test_bleu_one(self):
+        m = BLEUScore()
+        m.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        assert np.isclose(float(m.compute()), 1.0)
+
+    def test_rouge_one(self):
+        m = ROUGEScore(rouge_keys=("rouge1",))
+        m.update(["identical sentence"], ["identical sentence"])
+        assert np.isclose(float(m.compute()["rouge1_fmeasure"]), 1.0)
+
+
+class TestEmptyStrings:
+    def test_wer_empty_pred(self):
+        # deleting every reference word: WER = 1
+        assert float(word_error_rate([""], ["hello world"])) == 1.0
+
+    def test_cer_empty_pred(self):
+        assert float(char_error_rate([""], ["abc"])) == 1.0
+
+    def test_streaming_with_empty_batch_entry(self):
+        m = WordErrorRate()
+        m.update(["hello world", ""], ["hello world", "a b"])
+        # 0 errors / 2 words + 2 deletions / 2 words over 4 target words
+        assert np.isclose(float(m.compute()), 0.5)
+
+
+class TestUnicode:
+    def test_cer_unicode(self):
+        # substituting one accented char among four
+        got = float(char_error_rate(["café"], ["cafe"]))
+        assert np.isclose(got, 0.25)
+
+    def test_wer_unicode_words(self):
+        got = float(word_error_rate(["汉字 拼音"], ["汉字 拼法"]))
+        assert np.isclose(got, 0.5)
+
+
+class TestSingleStringInputs:
+    """Bare strings (not lists) are accepted like the reference."""
+
+    def test_wer_bare_string(self):
+        m = WordErrorRate()
+        m.update("hello world", "hello there")
+        assert np.isclose(float(m.compute()), 0.5)
+
+    def test_cer_bare_string(self):
+        assert float(char_error_rate("abcd", "abcf")) == 0.25
+
+
+class TestMismatchedLengths:
+    def test_unequal_corpus_sizes_raise(self):
+        with pytest.raises((ValueError, AssertionError)):
+            word_error_rate(["one", "two"], ["one"])
