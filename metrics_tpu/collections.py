@@ -61,6 +61,10 @@ class MetricCollection:
         self._enable_compute_groups = compute_groups
         self._groups_checked = False
         self._compute_groups: Dict[int, List[str]] = {}
+        # ONE jitted program updating every group leader per step (SURVEY §7
+        # stage 4's fused-kernel win); rebuilt whenever groups change
+        self._fused_update = None
+        self._fused_enabled = True
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -91,6 +95,7 @@ class MetricCollection:
         (nested collections flattened as ``<name>_<member>``) — the same
         three input shapes the reference supports (``collections.py:302-363``).
         """
+        self._invalidate_fused_update()  # leader set may change
         if isinstance(metrics, Metric):
             metrics = [metrics]
         if isinstance(metrics, dict):
@@ -185,9 +190,14 @@ class MetricCollection:
     def _update_via(self, method_name: str, *args: Any, **kwargs: Any) -> None:
         """Shared grouped/ungrouped dispatch for update and update_batched."""
         if self._groups_checked:
-            for group in self._compute_groups.values():
-                leader = self._modules[group[0]]
-                getattr(leader, method_name)(*args, **leader._filter_kwargs(**kwargs))
+            if not (
+                method_name == "update"
+                and self._fused_enabled
+                and self._try_fused_update(args, kwargs)
+            ):
+                for group in self._compute_groups.values():
+                    leader = self._modules[group[0]]
+                    getattr(leader, method_name)(*args, **leader._filter_kwargs(**kwargs))
             self._share_group_states()
         else:
             for m in self._modules.values():
@@ -195,6 +205,55 @@ class MetricCollection:
             if self._enable_compute_groups:
                 self._merge_compute_groups()
                 self._groups_checked = True
+
+    def _try_fused_update(self, args: tuple, kwargs: dict) -> bool:
+        """Update EVERY group leader in one compiled program.
+
+        Returns False (nothing executed) when any leader cannot trace —
+        the caller then runs the per-leader dispatch path.
+        """
+        leaders = [self._modules[g[0]] for g in self._compute_groups.values()]
+        if len(leaders) < 2:
+            return False  # one leader: the per-metric jit path is already one program
+        for m in leaders:
+            if m._buffer_states or m._is_synced or not m._can_jit(args, m._filter_kwargs(**kwargs)):
+                return False
+        for m in leaders:
+            m._pre_update(*args, **m._filter_kwargs(**kwargs))
+            m._computed = None
+            m._update_count += 1
+        if self._fused_update is None:
+            def fused(states: List[Dict[str, Any]], a: tuple, kw: dict) -> List[Dict[str, Any]]:
+                out = []
+                for m, st in zip(leaders, states):
+                    _, new = m._run_with_state(st, m._update_impl, a, m._filter_kwargs(**kw))
+                    out.append(new)
+                return out
+
+            # no donation: compute-group members alias the leaders' arrays
+            self._fused_update = jax.jit(fused)
+        try:
+            new_states = self._fused_update([dict(m._state) for m in leaders], args, kwargs)
+        except (
+            TypeError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.NonConcreteBooleanIndexError,
+        ):
+            # some leader's body needs concrete values: nothing executed,
+            # permanently use the per-leader path (which handles fallbacks)
+            self._fused_enabled = False
+            self._fused_update = None
+            for m in leaders:
+                m._update_count -= 1
+            return False
+        for m, new in zip(leaders, new_states):
+            m._state.update(new)
+        return True
+
+    def _invalidate_fused_update(self) -> None:
+        self._fused_update = None
 
     def _merge_compute_groups(self) -> None:
         """Group metrics whose post-first-update states are identical.
@@ -216,6 +275,7 @@ class MetricCollection:
             else:
                 target.append(name)
         self._compute_groups = dict(enumerate(groups))
+        self._invalidate_fused_update()  # new leader set -> stale fused program
         self._share_group_states()
 
     @staticmethod
@@ -284,7 +344,13 @@ class MetricCollection:
         if self._groups_checked:
             self._share_group_states()
 
+    def __getstate__(self) -> Dict[str, Any]:
+        d = self.__dict__.copy()
+        d["_fused_update"] = None  # jitted programs don't pickle
+        return d
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        self._invalidate_fused_update()  # closures over leaders don't deep-copy
         mc = deepcopy(self)
         if prefix:
             mc.prefix = self._check_arg(prefix, "prefix")

@@ -174,3 +174,58 @@ def test_compute_groups_no_state_alias_double_count_after_add_metrics():
     # curve metrics hold padded buffers: exactly 2 batches x 8 rows each
     assert mc["roc"]._state["preds__len"] == 16
     assert mc["prc"]._state["preds__len"] == 16
+
+
+def test_fused_group_leader_update():
+    """With >=2 compute groups, one jitted program updates every leader
+    (SURVEY §7 stage 4); values must match the unfused metrics."""
+    from sklearn.metrics import confusion_matrix as sk_cm
+    from sklearn.metrics import f1_score as sk_f1
+
+    from metrics_tpu import ConfusionMatrix, F1Score, Precision, Recall
+
+    rng = np.random.default_rng(11)
+    col = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=4, validate_args=False),
+            "f1": F1Score(num_classes=4, average="macro", validate_args=False),
+            "prec": Precision(num_classes=4, average="macro", validate_args=False),
+            "rec": Recall(num_classes=4, average="macro", validate_args=False),
+        }
+    )
+    preds = jnp.asarray(rng.integers(0, 4, (5, 64)))
+    target = jnp.asarray(rng.integers(0, 4, (5, 64)))
+    for i in range(5):
+        col.update(preds[i], target[i])
+    assert col._fused_update is not None  # the fused program engaged
+    # stat-scores trio shares one group; cm has its own
+    assert sorted(len(g) for g in col.compute_groups.values()) == [1, 3]
+    out = col.compute()
+    p = np.asarray(preds).reshape(-1)
+    t = np.asarray(target).reshape(-1)
+    np.testing.assert_allclose(float(out["f1"]), sk_f1(t, p, average="macro"), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out["cm"]), sk_cm(t, p))
+    # every member of the shared group must agree with its leader
+    np.testing.assert_allclose(float(out["prec"]), float(col["prec"].compute()), atol=1e-7)
+
+
+def test_fused_update_survives_add_metrics():
+    from metrics_tpu import ConfusionMatrix, F1Score, Precision
+
+    rng = np.random.default_rng(12)
+    col = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=3, validate_args=False),
+            "f1": F1Score(num_classes=3, average="macro", validate_args=False),
+        }
+    )
+    p = jnp.asarray(rng.integers(0, 3, 32))
+    t = jnp.asarray(rng.integers(0, 3, 32))
+    col.update(p, t)
+    col.update(p, t)
+    col.add_metrics({"prec": Precision(num_classes=3, average="macro", validate_args=False)})
+    col.update(p, t)  # re-detection pass
+    col.update(p, t)  # fused program rebuilt over the new leader set
+    assert col["cm"]._update_count == 4
+    assert col["prec"]._update_count == 2
+    col.compute()

@@ -56,6 +56,8 @@ def test_doctests(module_name):
 def test_discovery_is_broad():
     # regression guard: the sweep must keep covering the whole functional layer
     assert len(MODULES) >= 70
+    # and a silent import failure must not drop a required-example module
+    assert EXAMPLES_REQUIRED <= set(_discover_module_classes())
 
 
 # module-class layer: auto-discovered like the functional sweep, so new

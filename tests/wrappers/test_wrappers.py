@@ -186,3 +186,67 @@ class TestMetricTracker:
         out = metric.compute()
         assert np.isfinite(float(out["mean"]))
         assert np.isfinite(float(out["std"]))
+
+
+class TestBootStrapperVmapped:
+    """Multinomial strategy: all replicas run as ONE vmapped XLA program over
+    a stacked state pytree (SURVEY §7 stage 7)."""
+
+    def test_statistics_match_clone_loop_distribution(self):
+        rng = np.random.default_rng(5)
+        preds = jnp.asarray(rng.random((6, 64, 3), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 3, (6, 64)))
+        m = BootStrapper(
+            Accuracy(num_classes=3, validate_args=False),
+            num_bootstraps=50,
+            sampling_strategy="multinomial",
+            seed=3,
+        )
+        for i in range(6):
+            m.update(preds[i], target[i])
+        assert m._vmap_active is True
+        out = m.compute()
+        base = Accuracy(num_classes=3, validate_args=False)
+        for i in range(6):
+            base.update(preds[i], target[i])
+        true_acc = float(base.compute())
+        # bootstrap mean concentrates near the true value; std is positive
+        assert abs(float(out["mean"]) - true_acc) < 0.05
+        assert float(out["std"]) > 0
+
+    def test_raw_and_quantile_shapes(self):
+        rng = np.random.default_rng(6)
+        m = BootStrapper(
+            MeanSquaredError(),
+            num_bootstraps=16,
+            sampling_strategy="multinomial",
+            mean=True,
+            std=True,
+            quantile=0.95,
+            raw=True,
+        )
+        m.update(jnp.asarray(rng.normal(size=32).astype(np.float32)), jnp.asarray(rng.normal(size=32).astype(np.float32)))
+        out = m.compute()
+        assert out["raw"].shape == (16,)
+        assert out["quantile"].shape == ()
+
+    def test_reset_and_restream(self):
+        rng = np.random.default_rng(7)
+        m = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy="multinomial")
+        p = jnp.asarray(rng.normal(size=32).astype(np.float32))
+        m.update(p, p + 0.2)
+        first = float(m.compute()["mean"])
+        m.reset()
+        m.update(p, p + 0.2)
+        assert np.isclose(float(m.compute()["mean"]), first, atol=1e-6)
+
+    def test_pickle_mid_stream_continues(self):
+        import pickle
+
+        rng = np.random.default_rng(8)
+        m = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy="multinomial")
+        p = jnp.asarray(rng.normal(size=32).astype(np.float32))
+        m.update(p, p + 0.1)
+        clone = pickle.loads(pickle.dumps(m))
+        clone.update(p, p + 0.1)
+        assert np.isclose(float(clone.compute()["mean"]), 0.01, atol=1e-3)
