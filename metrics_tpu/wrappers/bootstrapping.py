@@ -35,6 +35,19 @@ def _bootstrap_sampler(
 
 
 class BootStrapper(Metric):
+    """Bootstrap confidence statistics over a base metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanSquaredError
+        >>> b = BootStrapper(MeanSquaredError(), num_bootstraps=20,
+        ...                  sampling_strategy="multinomial", seed=0)
+        >>> b.update(jnp.arange(16.0), jnp.arange(16.0) + 0.5)
+        >>> out = b.compute()
+        >>> sorted(out), round(float(out["mean"]), 2)
+        (['mean', 'std'], 0.25)
+    """
+
     full_state_update = True
     # update mutates child-metric state outside the swapped pytree → never trace
     jit_update_default = False

@@ -10,7 +10,16 @@ Array = jax.Array
 
 
 class ClasswiseWrapper(Metric):
-    """Split a per-class metric output into a ``{name_label: value}`` dict."""
+    """Split a per-class metric output into a ``{name_label: value}`` dict.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, ClasswiseWrapper
+        >>> cw = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        >>> cw.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 2, 2, 1]))
+        >>> {k: round(float(v), 2) for k, v in sorted(cw.compute().items())}
+        {'accuracy_0': 1.0, 'accuracy_1': 1.0, 'accuracy_2': 0.5}
+    """
 
     jit_update_default = False
     jit_compute_default = False

@@ -13,6 +13,15 @@ Array = jax.Array
 class MinMaxMetric(Metric):
     """Track the min and max of a wrapped metric's compute across an experiment.
 
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MinMaxMetric
+        >>> mm = MinMaxMetric(Accuracy(num_classes=2))
+        >>> mm.update(jnp.asarray([1, 1, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+        >>> out = mm.compute()
+        >>> float(out["raw"]), float(out["min"]), float(out["max"])
+        (0.75, 0.75, 0.75)
+
     The min/max are refreshed on every ``compute`` call (reference semantics).
     """
 

@@ -24,7 +24,17 @@ def _get_nan_indices(*tensors: Array) -> Array:
 
 
 class MultioutputWrapper(Metric):
-    """One clone of the base metric per output column; no cross-output aggregation."""
+    """One clone of the base metric per output column; no cross-output aggregation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> mo.update(jnp.asarray([[0.0, 1.0], [2.0, 3.0]]), jnp.asarray([[0.5, 1.0], [2.0, 2.0]]))
+        >>> np.round(np.asarray(mo.compute()), 3)
+        array([0.125, 0.5  ], dtype=float32)
+    """
 
     is_differentiable = False
     full_state_update = True

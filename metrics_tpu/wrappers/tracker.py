@@ -14,6 +14,16 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class MetricTracker:
     """Track a metric (or collection) over steps/epochs.
 
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricTracker
+        >>> tr = MetricTracker(Accuracy(num_classes=2), maximize=True)
+        >>> for step_preds in ([1, 0, 0, 0], [1, 1, 0, 0]):
+        ...     tr.increment()
+        ...     tr.update(jnp.asarray(step_preds), jnp.asarray([1, 1, 0, 0]))
+        >>> float(tr.best_metric())
+        1.0
+
     ``increment()`` snapshots a fresh copy; ``update``/``compute``/``forward``
     address the newest copy; ``compute_all``/``best_metric`` span all steps.
     """

@@ -83,6 +83,11 @@ EXAMPLES_REQUIRED = {
     "metrics_tpu.regression.spearman",
     "metrics_tpu.retrieval.reciprocal_rank",
     "metrics_tpu.text.rouge",
+    "metrics_tpu.wrappers.bootstrapping",
+    "metrics_tpu.wrappers.classwise",
+    "metrics_tpu.wrappers.minmax",
+    "metrics_tpu.wrappers.multioutput",
+    "metrics_tpu.wrappers.tracker",
 }
 
 
