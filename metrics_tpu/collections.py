@@ -316,6 +316,7 @@ class MetricCollection:
                         m.donate_state = False
                         m._jitted_update = None
                         m._jitted_update_batched = None
+                        m._jitted_forward = None
             for name in group[1:]:
                 member = self._modules[name]
                 for key in member._defaults:

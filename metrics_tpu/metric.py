@@ -43,6 +43,23 @@ Array = jax.Array
 
 _ALLOWED_REDUCE = ("sum", "mean", "max", "min", "cat")
 
+_FUSED_FORWARD_FAILED = object()  # sentinel: fused forward could not trace
+
+
+def _merge_tensor_state(fx: Any, global_val: Array, local_val: Array, global_count) -> Array:
+    """Forward fast-path O(1) merge for one tensor state (reference
+    ``metric.py:319-346`` semantics); shared by the fused (traced) and
+    stepwise (eager) forward paths."""
+    if fx == "sum":
+        return global_val + local_val
+    if fx == "mean":
+        return (global_count * global_val + local_val) / (global_count + 1)
+    if fx == "max":
+        return jnp.maximum(global_val, local_val)
+    if fx == "min":
+        return jnp.minimum(global_val, local_val)
+    raise MetricsTPUUserError(f"cannot fast-merge a state with reduce {fx!r}")
+
 
 def _is_jittable_leaf(x: Any) -> bool:
     return isinstance(x, (jax.Array, np.ndarray, numbers.Number, bool)) or x is None
@@ -136,6 +153,8 @@ class Metric(ABC):
         self._jitted_update: Optional[Callable] = None
         self._jitted_update_batched: Optional[Callable] = None
         self._jitted_compute: Optional[Callable] = None
+        self._jitted_forward: Optional[Callable] = None
+        self._forward_fused_ok: Optional[bool] = None
         self._update_called_warned = False
         self._dtype = jnp.float32
         self._install_wrappers()
@@ -790,7 +809,67 @@ class Metric(ABC):
         )
         if self.full_state_update or self.dist_sync_on_step or no_fast_merge:
             return self._forward_full_state_update(*args, **kwargs)
+        if (
+            self._forward_fused_ok is not False
+            and not self._buffer_states
+            and not self.compute_on_cpu
+            and self.jit_compute
+            and not any(fx == "cat" for fx in self._reduce_fns.values())
+            and self._can_jit(args, kwargs)
+        ):
+            fused = self._forward_fused(args, kwargs)
+            if fused is not _FUSED_FORWARD_FAILED:
+                return fused
         return self._forward_reduce_state_update(*args, **kwargs)
+
+    def _forward_fused(self, args: tuple, kwargs: dict) -> Any:
+        """The whole forward fast path as ONE compiled program.
+
+        The reference's fast path (``metric.py:282-317``) is reset + update +
+        compute + O(1) merge — four separate dispatches per training step.
+        Here the batch state starts from trace-time default constants, the
+        batch value and the merged global state come out of a single XLA
+        program, and the global state buffers are donated: one dispatch per
+        ``forward`` step.
+        """
+        self._pre_update(*args, **kwargs)
+        if self._jitted_forward is None:
+            def fused(global_state: Dict[str, Any], global_count, a: tuple, kw: dict):
+                batch_state = self.init_state()
+                _, batch_state = self._run_with_state(batch_state, self._update_impl, a, kw)
+                value, _ = self._run_with_state(batch_state, self._compute_impl, (), {})
+                merged = {
+                    name: _merge_tensor_state(
+                        self._reduce_fns[name], gv, batch_state[name], global_count
+                    )
+                    for name, gv in global_state.items()
+                }
+                return value, merged
+
+            donate = (0,) if self.donate_state else ()
+            self._jitted_forward = jax.jit(fused, donate_argnums=donate)
+        try:
+            with _quiet_donation():
+                value, merged = self._jitted_forward(self._state, self._update_count, args, kwargs)
+        except (
+            # NOT TypeError: an argument-binding mistake says nothing about
+            # traceability and must neither demote the path nor be swallowed
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.NonConcreteBooleanIndexError,
+        ):
+            # body needs concrete values: nothing executed; permanently use
+            # the stepwise path (which handles its own eager fallbacks)
+            self._forward_fused_ok = False
+            self._jitted_forward = None
+            return _FUSED_FORWARD_FAILED
+        self._forward_fused_ok = True
+        self._state.update(merged)
+        self._update_count += 1
+        self._computed = None
+        self._is_synced = False
+        return _squeeze_if_scalar(value)
 
     def _reset_for_forward(self) -> None:
         """Reset used by the forward batch-value dance.
@@ -870,16 +949,8 @@ class Metric(ABC):
                     self._state[name] = jnp.concatenate(
                         [jnp.atleast_1d(global_val), jnp.atleast_1d(local_val)], axis=0
                     )
-            elif fx == "sum":
-                self._state[name] = global_val + local_val
-            elif fx == "mean":
-                self._state[name] = (global_count * global_val + local_val) / (global_count + 1)
-            elif fx == "max":
-                self._state[name] = jnp.maximum(global_val, local_val)
-            elif fx == "min":
-                self._state[name] = jnp.minimum(global_val, local_val)
-            else:  # pragma: no cover - guarded in forward
-                raise MetricsTPUUserError(f"cannot reduce state {name!r} with {fx!r}")
+            else:
+                self._state[name] = _merge_tensor_state(fx, global_val, local_val, global_count)
 
     # ----------------------------------------------------------------- sync
     def _copy_state(self) -> Dict[str, Any]:
@@ -1041,6 +1112,7 @@ class Metric(ABC):
         self._jitted_update = None
         self._jitted_update_batched = None
         self._jitted_compute = None
+        self._jitted_forward = None
         return self
 
     def float(self) -> "Metric":
@@ -1114,6 +1186,7 @@ class Metric(ABC):
         d["_jitted_update"] = None
         d["_jitted_update_batched"] = None
         d["_jitted_compute"] = None
+        d["_jitted_forward"] = None
         d["_state"] = {
             k: (
                 [np.asarray(x) for x in v]

@@ -403,3 +403,65 @@ def test_merge_state_weighted_mean():
     d.update(jnp.asarray(10.0))
     c.merge_state(d.state)
     assert np.isclose(float(c.compute()), 6.0)
+
+
+class TestFusedForward:
+    """forward's fast path runs as ONE compiled program (reset + update +
+    compute + merge fused); values must match the stepwise path exactly."""
+
+    def test_batch_values_and_accumulation(self):
+        from metrics_tpu.classification import Accuracy
+
+        rng = np.random.default_rng(21)
+        fused_m = Accuracy(num_classes=3, validate_args=False)
+        step_m = Accuracy(num_classes=3, validate_args=False)
+        step_m._forward_fused_ok = False  # pin the stepwise path
+        for _ in range(4):
+            p = jnp.asarray(rng.random((16, 3), dtype=np.float32))
+            t = jnp.asarray(rng.integers(0, 3, 16))
+            bv_fused = float(fused_m(p, t))
+            bv_step = float(step_m(p, t))
+            assert np.isclose(bv_fused, bv_step)
+        assert fused_m._forward_fused_ok is True
+        assert np.isclose(float(fused_m.compute()), float(step_m.compute()))
+        assert fused_m.update_count == step_m.update_count == 4
+
+    def test_single_trace_across_steps(self):
+        from metrics_tpu.classification import Accuracy
+
+        m = Accuracy(num_classes=3, validate_args=False)
+        rng = np.random.default_rng(22)
+        for _ in range(5):
+            m(jnp.asarray(rng.random((8, 3), dtype=np.float32)), jnp.asarray(rng.integers(0, 3, 8)))
+        assert m._jitted_forward is not None
+        assert m._jitted_forward._cache_size() == 1
+
+    def test_mean_reduce_states_weighting(self):
+        class RunningMean(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("v", jnp.zeros(()), dist_reduce_fx="mean")
+
+            def update(self, x):
+                self.v = x.mean()
+
+            def compute(self):
+                return self.v
+
+        m = RunningMean()
+        vals = [1.0, 5.0, 3.0]
+        for v in vals:
+            bv = float(m(jnp.full((4,), v)))
+            assert np.isclose(bv, v)  # batch value is THIS batch's mean
+        assert np.isclose(float(m.compute()), np.mean(vals))
+
+    def test_interleaved_update_and_forward(self):
+        from metrics_tpu import MeanSquaredError
+
+        rng = np.random.default_rng(23)
+        m = MeanSquaredError()
+        x = jnp.asarray(rng.normal(size=16).astype(np.float32))
+        m.update(x, x + 1.0)          # plain update
+        bv = float(m(x, x + 3.0))     # fused forward
+        assert np.isclose(bv, 9.0, atol=1e-5)
+        assert np.isclose(float(m.compute()), 5.0, atol=1e-5)  # mean of 1 and 9
