@@ -146,6 +146,19 @@ class MeanAveragePrecision(Metric):
     ``target[i] = {boxes (M,4), labels (M,)}`` (plus ``masks`` when
     ``iou_type='segm'``).  States are per-image list states all-gathered at
     sync (reference ``mean_ap.py:339-343``).
+
+    Example:
+        >>> import numpy as np
+        >>> from metrics_tpu import MeanAveragePrecision
+        >>> metric = MeanAveragePrecision()
+        >>> preds = [dict(boxes=np.asarray([[10.0, 10.0, 60.0, 60.0]]),
+        ...               scores=np.asarray([0.9]), labels=np.asarray([0]))]
+        >>> target = [dict(boxes=np.asarray([[12.0, 12.0, 58.0, 58.0]]),
+        ...                labels=np.asarray([0]))]
+        >>> metric.update(preds, target)
+        >>> out = metric.compute()
+        >>> round(float(out["map"]), 4), round(float(out["map_50"]), 4)
+        (0.7, 1.0)
     """
 
     is_differentiable = False
