@@ -1,0 +1,55 @@
+"""Example: the three single-dispatch streaming shapes.
+
+1. ``metric(batch)`` — forward: batch value + accumulation, fused into one
+   compiled program with donated state buffers.
+2. ``metric.update_batched(stack)`` — a whole stacked stream folded through
+   one ``lax.scan`` program.
+3. ``BootStrapper(..., "multinomial")`` — every bootstrap replica in one
+   vmapped program.
+
+Run anywhere: ``JAX_PLATFORMS=cpu python examples/fused_streaming.py``
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import BootStrapper
+from metrics_tpu.classification import Accuracy
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    n_batches, batch, classes = 32, 512, 10
+    preds = jnp.asarray(rng.random((n_batches, batch, classes), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, classes, size=(n_batches, batch)))
+
+    # 1. training-loop shape: per-step batch value, one dispatch per step
+    metric = Accuracy(num_classes=classes, validate_args=False)
+    for i in range(n_batches):
+        batch_acc = metric(preds[i], target[i])
+    print(f"last-batch acc {float(batch_acc):.4f}  epoch acc {float(metric.compute()):.4f}")
+
+    # 2. stacked-stream shape: the whole epoch in ONE dispatch
+    fused = Accuracy(num_classes=classes, validate_args=False)
+    fused.update_batched(preds, target)
+    assert np.isclose(float(fused.compute()), float(metric.compute()))
+    print(f"fused epoch acc  {float(fused.compute()):.4f}  (update_batched == loop)")
+
+    # 3. bootstrap confidence band: all replicas in one vmapped program
+    boot = BootStrapper(
+        Accuracy(num_classes=classes, validate_args=False),
+        num_bootstraps=50,
+        sampling_strategy="multinomial",
+        seed=1,
+    )
+    for i in range(n_batches):
+        boot.update(preds[i], target[i])
+    out = boot.compute()
+    print(f"bootstrap acc    {float(out['mean']):.4f} +/- {float(out['std']):.4f}")
+
+    jax.block_until_ready(out["mean"])
+
+
+if __name__ == "__main__":
+    main()
