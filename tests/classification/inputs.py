@@ -86,3 +86,8 @@ _multilabel_multidim_inputs = Input(
 # nothing matches: every score is undefined-edge territory (reference inputs.py:64-68)
 __no_match_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
 _multilabel_no_match_inputs = Input(preds=__no_match_preds, target=1 - __no_match_preds)
+
+_multiclass_logits_inputs = Input(
+    preds=(10 * _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))).astype(np.float32),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)

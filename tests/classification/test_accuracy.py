@@ -12,6 +12,7 @@ from tests.classification.inputs import (
     _binary_logits_inputs,
     _binary_prob_inputs,
     _multiclass_inputs,
+    _multiclass_logits_inputs,
     _multiclass_prob_inputs,
     _multidim_multiclass_inputs,
     _multidim_multiclass_prob_inputs,
@@ -61,6 +62,7 @@ class TestAccuracy(MetricTester):
             (_multilabel_multidim_prob_inputs.preds, _multilabel_multidim_prob_inputs.target, False),
             (_multilabel_multidim_inputs.preds, _multilabel_multidim_inputs.target, False),
             (_multiclass_prob_inputs.preds, _multiclass_prob_inputs.target, False),
+            (_multiclass_logits_inputs.preds, _multiclass_logits_inputs.target, False),
             (_multiclass_inputs.preds, _multiclass_inputs.target, False),
             (_multidim_multiclass_prob_inputs.preds, _multidim_multiclass_prob_inputs.target, False),
             (_multidim_multiclass_inputs.preds, _multidim_multiclass_inputs.target, False),
