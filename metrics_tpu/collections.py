@@ -241,8 +241,11 @@ class MetricCollection:
             jax.errors.TracerIntegerConversionError,
             jax.errors.NonConcreteBooleanIndexError,
         ):
-            # some leader's body needs concrete values: nothing executed,
-            # permanently use the per-leader path (which handles fallbacks)
+            # some leader's body needs concrete values (or the caller passed
+            # unbindable arguments): nothing executed — use the per-leader
+            # path, which re-runs eagerly and surfaces any real input error.
+            # Demotion lasts until reset() so one transient bad input does
+            # not cost the fused path for the collection's lifetime
             self._fused_enabled = False
             self._fused_update = None
             for m in leaders:
@@ -254,6 +257,8 @@ class MetricCollection:
 
     def _invalidate_fused_update(self) -> None:
         self._fused_update = None
+        # a new leader set also clears any transient demotion
+        self._fused_enabled = True
 
     def _merge_compute_groups(self) -> None:
         """Group metrics whose post-first-update states are identical.
@@ -342,6 +347,9 @@ class MetricCollection:
     def reset(self) -> None:
         for m in self._modules.values():
             m.reset()
+        # a past trace/argument failure must not demote future epochs (the
+        # compiled program itself is kept: stable traces epoch to epoch)
+        self._fused_enabled = True
         if self._groups_checked:
             self._share_group_states()
 

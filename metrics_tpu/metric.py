@@ -232,6 +232,13 @@ class Metric(ABC):
         Replaces the reference's list states for the curve metrics
         (reference ``precision_recall_curve.py`` / ``auroc.py`` keep
         ``preds``/``target`` lists, ``classification/auroc.py:144-152``).
+
+        .. note:: growth-by-doubling only happens eagerly.  Callers driving
+           the pure :meth:`apply_update` API under their own ``jit`` /
+           ``shard_map`` must pre-size ``capacity`` for the whole stream:
+           in-trace appends have fixed shapes, so overflow clamps into the
+           tail.  Overflow is detected (and raised) the next time the buffer
+           is read via :meth:`buffer_values` / ``compute``.
         """
         if dist_reduce_fx != "cat":
             raise ValueError("buffer states currently support only 'cat' reduction")
@@ -349,9 +356,26 @@ class Metric(ABC):
             lengths = [int(c) for c in (cnt if isinstance(cnt, (tuple, list)) else np.asarray(cnt))]
             d = len(lengths)
             cap = buf.shape[0] // max(d, 1)
+            if any(c > cap for c in lengths):
+                raise MetricsTPUUserError(
+                    f"buffer state {name!r} overflowed its capacity {cap} inside a "
+                    f"traced update (per-device row counts {lengths}); in-trace "
+                    "appends clamp instead of growing — pre-size the buffer for "
+                    "the whole stream (``add_buffer_state(capacity=...)``) when "
+                    "driving updates through the pure apply_update API"
+                )
             parts = [buf[i * cap : i * cap + c] for i, c in enumerate(lengths)]
             return jnp.concatenate(parts, axis=0) if parts else buf[:0]
-        return buf[: int(cnt)]
+        total = int(cnt)
+        if total > buf.shape[0]:
+            raise MetricsTPUUserError(
+                f"buffer state {name!r} holds {total} rows but only capacity "
+                f"{buf.shape[0]}: appends under a trace clamp instead of growing, "
+                "so the tail was overwritten — pre-size the buffer for the whole "
+                "stream (``add_buffer_state(capacity=...)``) when driving updates "
+                "through the pure apply_update API"
+            )
+        return buf[:total]
 
     def buffer_values(self, name: str) -> Array:
         """The valid rows of buffer state ``name`` (compute-side accessor)."""

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 Array = jax.Array
 
@@ -121,6 +122,20 @@ class BootStrapper(Metric):
             # the base metric opted out of tracing (e.g. host-side NaN
             # handling); forcing it under vmap would silently skip those paths
             return False
+        if template._buffer_states:
+            # stacking a buffer state turns its python-int row count into a
+            # traced array and its placeholder (0,)-capacity buffer into the
+            # template, so the in-trace append cannot work; per-clone eager
+            # updates handle growth correctly
+            return False
+        # lock value-dependent input handling (classification mode detection)
+        # on concrete inputs, exactly as the eager per-clone path would
+        template._pre_update(*args, **kwargs)
+        if self._stacked_state is None:
+            # the OTHER clones must carry the same lock: a later demotion
+            # unstacks state into them and runs their eager compute/update
+            for m in self.metrics[1:]:
+                m._pre_update(*args, **kwargs)
         idx = jnp.asarray(
             self._rng.integers(0, size, size=(self.num_bootstraps, size))
         )
@@ -149,6 +164,7 @@ class BootStrapper(Metric):
             new_stacked = self._vmapped_update(self._stacked_state, idx, args, kwargs)
         except (
             TypeError,
+            MetricsTPUUserError,
             jax.errors.ConcretizationTypeError,
             jax.errors.TracerArrayConversionError,
             jax.errors.TracerIntegerConversionError,
@@ -199,6 +215,7 @@ class BootStrapper(Metric):
                 computed_vals = self._vmapped_compute(self._stacked_state)
             except (
                 TypeError,
+                MetricsTPUUserError,
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError,

@@ -209,6 +209,31 @@ def test_fused_group_leader_update():
     np.testing.assert_allclose(float(out["prec"]), float(col["prec"].compute()), atol=1e-7)
 
 
+def test_fused_update_reprobes_after_reset():
+    """A transient bad input demotes the fused path only until reset()
+    (ADVICE r2: permanent demotion punished a one-off caller mistake)."""
+    from metrics_tpu import ConfusionMatrix, F1Score
+
+    rng = np.random.default_rng(13)
+    col = MetricCollection(
+        {
+            "cm": ConfusionMatrix(num_classes=3, validate_args=False),
+            "f1": F1Score(num_classes=3, average="macro", validate_args=False),
+        }
+    )
+    p = jnp.asarray(rng.integers(0, 3, 32))
+    t = jnp.asarray(rng.integers(0, 3, 32))
+    col.update(p, t)  # group detection pass
+    col._fused_enabled = False  # as if a bad input demoted the fused path
+    col.update(p, t)
+    col.reset()
+    col.update(p, t)  # detection pass of the new epoch
+    col.update(p, t)
+    assert col._fused_enabled is True
+    assert col._fused_update is not None  # fused path re-engaged after reset
+    col.compute()
+
+
 def test_fused_update_survives_add_metrics():
     from metrics_tpu import ConfusionMatrix, F1Score, Precision
 

@@ -183,6 +183,37 @@ class TestBufferedCurveStates:
         self._stream(m, 20)  # same shapes, same capacities -> no new traces
         assert m._jitted_update._cache_size() == traces_before
 
+    def test_pure_api_traced_overflow_detected_at_read(self):
+        """In-trace appends clamp instead of growing; the corruption must be
+        DETECTED at read time, not silently returned (ADVICE r2 medium)."""
+        import jax
+
+        from metrics_tpu.metric import Metric
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        class Tiny(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_buffer_state("rows", capacity=8)
+
+            def update(self, x):
+                self._buffer_append("rows", x)
+
+            def compute(self):
+                return self.buffer_values("rows").sum()
+
+        m = Tiny()
+        state = m.init_state()
+        x = jnp.ones(4)
+        state = m.apply_update(state, x)  # eager: allocates the capacity-8 buffer
+        step = jax.jit(m.apply_update)
+        state = step(state, x)  # 8 rows: exactly full
+        state = step(state, x)  # 12 rows into capacity 8: clamps in-trace
+        with pytest.raises(MetricsTPUUserError, match="capacity"):
+            m.apply_compute(state)
+
     def test_update_batched_stream(self):
         from metrics_tpu.classification import PrecisionRecallCurve
 

@@ -250,3 +250,35 @@ class TestBootStrapperVmapped:
         clone = pickle.loads(pickle.dumps(m))
         clone.update(p, p + 0.1)
         assert np.isclose(float(clone.compute()["mean"]), 0.01, atol=1e-3)
+
+    @pytest.mark.parametrize("base_cls", ["auroc", "prc"])
+    def test_buffer_state_base_falls_back_to_clone_loop(self, base_cls):
+        """Buffer-state base metrics (curve family) cannot stack: the vmapped
+        path must decline and the eager per-clone loop must produce correct
+        statistics (ADVICE r2 high finding — this crashed before)."""
+        from metrics_tpu import AUROC, PrecisionRecallCurve
+
+        rng = np.random.default_rng(9)
+        base = AUROC(pos_label=1) if base_cls == "auroc" else PrecisionRecallCurve(pos_label=1)
+        m = BootStrapper(
+            base,
+            num_bootstraps=6,
+            sampling_strategy="multinomial",
+            mean=base_cls == "auroc",
+            std=base_cls == "auroc",
+            raw=base_cls == "auroc",
+        )
+        preds = jnp.asarray(rng.random(64, dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 2, 64))
+        if base_cls == "prc":
+            # tuple-valued compute can't stack either; just assert no crash
+            m.update(preds, target)
+            assert m._vmap_active is False
+            return
+        for _ in range(3):
+            m.update(preds, target)
+        assert m._vmap_active is False  # declined, not crashed
+        out = m.compute()
+        assert out["raw"].shape == (6,)
+        assert np.isfinite(float(out["mean"]))
+        assert float(out["std"]) >= 0
