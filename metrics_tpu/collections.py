@@ -19,7 +19,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _flatten_batched_inputs
 from metrics_tpu.utils.data import _flatten_dict, allclose
 
 Array = jax.Array
@@ -64,6 +64,7 @@ class MetricCollection:
         # ONE jitted program updating every group leader per step (SURVEY §7
         # stage 4's fused-kernel win); rebuilt whenever groups change
         self._fused_update = None
+        self._fused_update_batched: Optional[Dict[Any, Any]] = None
         self._fused_enabled = True
 
         self.add_metrics(metrics, *additional_metrics)
@@ -190,11 +191,14 @@ class MetricCollection:
     def _update_via(self, method_name: str, *args: Any, **kwargs: Any) -> None:
         """Shared grouped/ungrouped dispatch for update and update_batched."""
         if self._groups_checked:
-            if not (
-                method_name == "update"
-                and self._fused_enabled
-                and self._try_fused_update(args, kwargs)
-            ):
+            fused = False
+            if self._fused_enabled:
+                fused = (
+                    self._try_fused_update(args, kwargs)
+                    if method_name == "update"
+                    else self._try_fused_update_batched(args, kwargs)
+                )
+            if not fused:
                 for group in self._compute_groups.values():
                     leader = self._modules[group[0]]
                     getattr(leader, method_name)(*args, **leader._filter_kwargs(**kwargs))
@@ -255,8 +259,83 @@ class MetricCollection:
             m._state.update(new)
         return True
 
+    def _try_fused_update_batched(self, args: tuple, kwargs: dict) -> bool:
+        """Fold a stack of batches through EVERY group leader in ONE program.
+
+        The whole-collection analogue of :meth:`Metric.update_batched`: one
+        ``lax.scan`` over the leading ``n_batches`` axis whose body updates
+        every leader's state — one dispatch per stream for the entire
+        collection, not one per compute group (VERDICT r2 #6).
+        """
+        leaders = [self._modules[g[0]] for g in self._compute_groups.values()]
+        if len(leaders) < 2:
+            return False  # one leader: Metric.update_batched is already one program
+        all_leaves, treedef, is_batched, statics, n, ragged = _flatten_batched_inputs(args, kwargs)
+        if n is None or n == 0 or ragged:
+            return False  # missing/empty/ragged stacks: the per-leader path handles/raises
+        try:
+            statics_key = (treedef, statics)
+            hash(statics_key)
+        except TypeError:
+            return False
+        slice_it = (x[0] for x, b in zip(all_leaves, is_batched) if b)
+        slice_leaves = [next(slice_it) if b else s for b, s in zip(is_batched, statics)]
+        sl_args, sl_kwargs = jax.tree_util.tree_unflatten(treedef, slice_leaves)
+        for m in leaders:
+            if (
+                m._buffer_states
+                or m._is_synced
+                or not m._can_jit(sl_args, m._filter_kwargs(**sl_kwargs))
+            ):
+                return False
+        for m in leaders:
+            m._pre_update(*sl_args, **m._filter_kwargs(**sl_kwargs))
+            m._computed = None
+            m._update_count += n
+        if self._fused_update_batched is None:
+            self._fused_update_batched = {}
+        fused = self._fused_update_batched.get(statics_key)
+        if fused is None:
+            def fused_many(states: List[Dict[str, Any]], arr_stack: tuple) -> List[Dict[str, Any]]:
+                def body(sts: List[Dict[str, Any]], sl: tuple):
+                    it = iter(sl)
+                    leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
+                    a, kw = jax.tree_util.tree_unflatten(treedef, leaves)
+                    out = []
+                    for m, st in zip(leaders, sts):
+                        _, new = m._run_with_state(st, m._update_impl, a, m._filter_kwargs(**kw))
+                        out.append(new)
+                    return out, None
+
+                new_states, _ = jax.lax.scan(body, states, arr_stack)
+                return new_states
+
+            # no donation: compute-group members alias the leaders' arrays
+            fused = jax.jit(fused_many)
+            self._fused_update_batched[statics_key] = fused
+        arr_stack = tuple(x for x, b in zip(all_leaves, is_batched) if b)
+        try:
+            new_states = fused([dict(m._state) for m in leaders], arr_stack)
+        except (
+            TypeError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.NonConcreteBooleanIndexError,
+        ):
+            # trace-time failure: nothing executed; demote until reset()
+            self._fused_enabled = False
+            self._fused_update_batched.pop(statics_key, None)
+            for m in leaders:
+                m._update_count -= n
+            return False
+        for m, new in zip(leaders, new_states):
+            m._state.update(new)
+        return True
+
     def _invalidate_fused_update(self) -> None:
         self._fused_update = None
+        self._fused_update_batched = None
         # a new leader set also clears any transient demotion
         self._fused_enabled = True
 
@@ -356,6 +435,7 @@ class MetricCollection:
     def __getstate__(self) -> Dict[str, Any]:
         d = self.__dict__.copy()
         d["_fused_update"] = None  # jitted programs don't pickle
+        d["_fused_update_batched"] = None
         return d
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":

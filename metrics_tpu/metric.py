@@ -65,6 +65,25 @@ def _is_jittable_leaf(x: Any) -> bool:
     return isinstance(x, (jax.Array, np.ndarray, numbers.Number, bool)) or x is None
 
 
+def _flatten_batched_inputs(args: tuple, kwargs: dict):
+    """Flatten ``(args, kwargs)`` and classify leaves for a stacked stream.
+
+    Array leaves (``ndim >= 1``) carry the leading ``n_batches`` axis; every
+    other leaf is a pass-through static.  Returns
+    ``(all_leaves, treedef, is_batched, statics, n, ragged)`` where ``n`` is
+    ``None`` when no array leaf exists and ``ragged`` flags mismatched
+    leading axes.  Shared by :meth:`Metric.update_batched` and the
+    collection-level fused stream so the leaf heuristic cannot drift.
+    """
+    all_leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    is_batched = [hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 for x in all_leaves]
+    batched = [x for x, b in zip(all_leaves, is_batched) if b]
+    statics = tuple(None if b else x for x, b in zip(all_leaves, is_batched))
+    n = batched[0].shape[0] if batched else None
+    ragged = any(x.shape[0] != n for x in batched)
+    return all_leaves, treedef, is_batched, statics, n, ragged
+
+
 class _quiet_donation(warnings.catch_warnings):
     """Suppress jax's 'Some donated buffers were not usable' noise.
 
@@ -689,22 +708,19 @@ class Metric(ABC):
         unchanged to every slice.  Falls back to the per-slice Python loop for
         list states and non-jittable inputs.
         """
-        all_leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        is_batched = [hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 for x in all_leaves]
-        batched = [x for x, b in zip(all_leaves, is_batched) if b]
-        if not batched:
+        all_leaves, treedef, is_batched, statics, n, ragged = _flatten_batched_inputs(args, kwargs)
+        if n is None:
             raise MetricsTPUUserError(
                 "update_batched needs array inputs with a leading n_batches axis"
             )
-        n = batched[0].shape[0]
-        if any(x.shape[0] != n for x in batched):
+        if ragged:
+            sizes = sorted({x.shape[0] for x, b in zip(all_leaves, is_batched) if b})
             raise MetricsTPUUserError(
                 "update_batched: all array inputs must share the leading n_batches axis; "
-                f"got sizes {sorted({x.shape[0] for x in batched})}"
+                f"got sizes {sizes}"
             )
         if n == 0:
             return  # an empty stack is zero update() calls
-        statics = tuple(None if b else x for x, b in zip(all_leaves, is_batched))
 
         def _slice(index) -> tuple:
             """(args, kwargs) at one slice/range; non-array leaves unchanged."""

@@ -6,8 +6,19 @@ replica a with-replacement resample of the batch along dim 0.
 ``'multinomial'`` keeps the batch shape static, so all replicas run as ONE
 ``vmap``-ped XLA program over a stacked state pytree (SURVEY §7 stage 7 —
 the TPU replacement for the reference's N deep copies and N Python update
-calls per batch).  ``'poisson'`` matches the reference's default exactly but
-produces variable-length resamples, so it keeps the per-clone eager loop.
+calls per batch).
+
+``'poisson'`` (the reference's default) draws per-sample counts
+``n_i ~ Poisson(1)`` — variable-length resamples.  The TPU-native shape
+uses the splitting property of the Poisson process: conditional on the
+total ``N = sum(n_i) ~ Poisson(size)``, the resampled rows are ``N`` iid
+uniform draws.  So each replica gets a FIXED-capacity uniform index row
+plus a concrete valid-count, and the update folds fixed-size index chunks
+under ``lax.scan`` with an all-or-nothing state select per chunk (plus
+single-row steps for the remainder).  Splitting one resample into chunk
+sub-updates is exact for any streaming metric: state folds must be
+batch-split invariant (the reference feeds arbitrary batch splits across
+steps — same contract).
 """
 
 from copy import deepcopy
@@ -21,6 +32,17 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 Array = jax.Array
+
+
+def _take_batch_rows(tree: Any, rows: Array, batch: int) -> Any:
+    """Resample every batch-shaped leaf of ``tree`` at ``rows`` (leaves whose
+    leading axis is not the batch axis pass through unchanged)."""
+    return jax.tree_util.tree_map(
+        lambda x: x[rows]
+        if hasattr(x, "ndim") and getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch
+        else x,
+        tree,
+    )
 
 
 def _bootstrap_sampler(
@@ -86,11 +108,15 @@ class BootStrapper(Metric):
         self.sampling_strategy = sampling_strategy
         self.seed = seed
         self._rng = np.random.default_rng(seed)
-        # vmapped fast path (multinomial): replicas live as ONE stacked state
+        # vmapped fast path: replicas live as ONE stacked state
         self._stacked_state: Optional[Dict[str, Array]] = None
         self._vmapped_update = None
+        self._vmapped_update_poisson: Optional[Dict[tuple, Any]] = None
         self._vmapped_compute = None
         self._vmap_active: Optional[bool] = None  # pinned on first update
+        # rows each replica has consumed (poisson replicas can draw empty
+        # resamples; a never-fed replica must not poison the statistics)
+        self._replica_rows: Optional[np.ndarray] = None
 
     @staticmethod
     def _batch_size(args: tuple, kwargs: dict) -> int:
@@ -107,17 +133,17 @@ class BootStrapper(Metric):
             m._state.update(
                 jax.tree_util.tree_map(lambda x: x[i], self._stacked_state)
             )
-            m._update_count = self._update_count
+            # a replica that only ever drew empty poisson resamples holds its
+            # init state: count 0 keeps it out of the eager compute statistics
+            if self._replica_rows is not None and self._replica_rows[i] == 0:
+                m._update_count = 0
+            else:
+                m._update_count = self._update_count
             m._computed = None
         self._stacked_state = None
 
-    def _update_vmapped(self, args: tuple, kwargs: dict, size: int) -> bool:
-        """All replicas in one program: vmap the pure update over stacked state.
-
-        Returns False (nothing executed) when the base update cannot trace;
-        the caller falls back to the per-clone loop.
-        """
-        template = self.metrics[0]
+    def _vmap_prepare(self, template: Metric, args: tuple, kwargs: dict) -> bool:
+        """Shared eligibility + mode-locking for the vmapped strategies."""
         if not template._can_jit(args, kwargs):
             # the base metric opted out of tracing (e.g. host-side NaN
             # handling); forcing it under vmap would silently skip those paths
@@ -136,25 +162,34 @@ class BootStrapper(Metric):
             # unstacks state into them and runs their eager compute/update
             for m in self.metrics[1:]:
                 m._pre_update(*args, **kwargs)
-        idx = jnp.asarray(
-            self._rng.integers(0, size, size=(self.num_bootstraps, size))
-        )
+        return True
+
+    def _ensure_stacked_state(self) -> None:
         if self._stacked_state is None:
             states = [m._copy_state() for m in self.metrics]
             self._stacked_state = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states
             )
+
+    def _update_vmapped(self, args: tuple, kwargs: dict, size: int) -> bool:
+        """All replicas in one program: vmap the pure update over stacked state.
+
+        Returns False (nothing executed) when the base update cannot trace;
+        the caller falls back to the per-clone loop.
+        """
+        template = self.metrics[0]
+        if not self._vmap_prepare(template, args, kwargs):
+            return False
+        idx = jnp.asarray(
+            self._rng.integers(0, size, size=(self.num_bootstraps, size))
+        )
+        self._ensure_stacked_state()
         if self._vmapped_update is None:
             def vmapped(stacked, idx_all, a, kw):
                 batch = idx_all.shape[1]
 
                 def one(state, idx_row):
-                    sl_a, sl_kw = jax.tree_util.tree_map(
-                        lambda x: x[idx_row]
-                        if hasattr(x, "ndim") and getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch
-                        else x,
-                        (a, kw),
-                    )
+                    sl_a, sl_kw = _take_batch_rows((a, kw), idx_row, batch)
                     return template.apply_update(state, *sl_a, **sl_kw)
 
                 return jax.vmap(one, in_axes=(0, 0))(stacked, idx_all)
@@ -179,11 +214,95 @@ class BootStrapper(Metric):
         self._stacked_state = new_stacked
         return True
 
+    def _update_vmapped_poisson(self, args: tuple, kwargs: dict, size: int) -> bool:
+        """All poisson replicas in one program over fixed-capacity resamples.
+
+        Poisson-process splitting: per-sample counts ``n_i ~ Poisson(1)``
+        are equivalent to a total ``N ~ Poisson(size)`` of iid uniform row
+        draws.  Each replica carries a static ``(capacity,)`` uniform index
+        row plus its concrete valid count; the program folds ``chunk`` rows
+        per ``lax.scan`` step with an all-or-nothing state select, then up
+        to ``chunk - 1`` single-row steps for the remainder.  One dispatch
+        per batch instead of the reference's N Python update calls
+        (reference ``bootstrapping.py:26-46``, poisson default).
+        """
+        template = self.metrics[0]
+        if not self._vmap_prepare(template, args, kwargs):
+            return False
+        reps = self.num_bootstraps
+        chunk = min(8, size)
+        cap = size + 5 * int(np.ceil(np.sqrt(size))) + 10
+        cap = ((cap + chunk - 1) // chunk) * chunk
+        counts = np.minimum(self._rng.poisson(size, reps), cap).astype(np.int32)
+        idx = jnp.asarray(self._rng.integers(0, size, size=(reps, cap)), jnp.int32)
+        if self._replica_rows is None:
+            self._replica_rows = np.zeros(reps, np.int64)
+        self._ensure_stacked_state()
+        if self._vmapped_update_poisson is None:
+            self._vmapped_update_poisson = {}
+        key = (size, cap, chunk)
+        prog = self._vmapped_update_poisson.get(key)
+        if prog is None:
+            n_chunks = cap // chunk
+
+            def one(state, idx_row, n_valid, a, kw):
+                def fold(st, rows, use):
+                    sl_a, sl_kw = _take_batch_rows((a, kw), rows, size)
+                    new = template.apply_update(st, *sl_a, **sl_kw)
+                    return jax.tree_util.tree_map(
+                        lambda nw, od: jnp.where(use, nw, od.astype(nw.dtype)), new, st
+                    )
+
+                def chunk_body(st, j):
+                    rows = jax.lax.dynamic_slice(idx_row, (j * chunk,), (chunk,))
+                    return fold(st, rows, (j + 1) * chunk <= n_valid), None
+
+                st, _ = jax.lax.scan(chunk_body, state, jnp.arange(n_chunks))
+
+                def row_body(st, t):
+                    pos = (n_valid // chunk) * chunk + t
+                    rows = jax.lax.dynamic_slice(idx_row, (pos,), (1,))
+                    return fold(st, rows, t < n_valid % chunk), None
+
+                if chunk > 1:
+                    st, _ = jax.lax.scan(row_body, st, jnp.arange(chunk - 1))
+                return st
+
+            prog = jax.jit(
+                lambda stacked, idx_all, n_all, a, kw: jax.vmap(
+                    one, in_axes=(0, 0, 0, None, None)
+                )(stacked, idx_all, n_all, a, kw)
+            )
+            self._vmapped_update_poisson[key] = prog
+        try:
+            new_stacked = prog(self._stacked_state, idx, jnp.asarray(counts), args, kwargs)
+        except (
+            TypeError,
+            MetricsTPUUserError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.NonConcreteBooleanIndexError,
+        ):
+            self._vmapped_update_poisson.pop(key, None)
+            self._unstack_into_clones()
+            return False
+        self._stacked_state = new_stacked
+        self._replica_rows += counts
+        return True
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Feed each replica a resampled batch (reference ``bootstrapping.py:122-138``)."""
         size = self._batch_size(args, kwargs)
-        if self._vmap_active is not False and self.sampling_strategy == "multinomial":
-            if self._update_vmapped(args, kwargs, size):
+        if size == 0:
+            return  # every resample of an empty batch is empty: no-op
+        if self._vmap_active is not False:
+            ran = (
+                self._update_vmapped(args, kwargs, size)
+                if self.sampling_strategy == "multinomial"
+                else self._update_vmapped_poisson(args, kwargs, size)
+            )
+            if ran:
                 self._vmap_active = True
                 return
             self._vmap_active = False
@@ -226,6 +345,14 @@ class BootStrapper(Metric):
                 self._unstack_into_clones()
                 self._vmap_active = False
                 self._vmapped_compute = None
+            else:
+                if self._replica_rows is not None and (self._replica_rows == 0).any():
+                    # replicas that only drew empty poisson resamples hold
+                    # init state; including them would poison the statistics
+                    keep = jnp.asarray(self._replica_rows > 0)
+                    if not bool(keep.any()):
+                        keep = jnp.ones_like(keep)
+                    computed_vals = computed_vals[keep]
         if computed_vals is None:
             # clones that only ever drew empty poisson resamples have no data;
             # including them would NaN-poison every statistic
@@ -252,15 +379,18 @@ class BootStrapper(Metric):
             m.reset()
         self._rng = np.random.default_rng(self.seed)
         self._stacked_state = None
+        self._replica_rows = None
         # a past trace failure must not demote future epochs: re-probe
         self._vmap_active = None
         self._vmapped_update = None
+        self._vmapped_update_poisson = None
         self._vmapped_compute = None
         super().reset()
 
     def __getstate__(self) -> Dict[str, Any]:
         d = super().__getstate__()
         d["_vmapped_update"] = None
+        d["_vmapped_update_poisson"] = None
         d["_vmapped_compute"] = None
         if d.get("_stacked_state") is not None:
             d["_stacked_state"] = {

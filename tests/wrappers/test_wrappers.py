@@ -251,6 +251,57 @@ class TestBootStrapperVmapped:
         clone.update(p, p + 0.1)
         assert np.isclose(float(clone.compute()["mean"]), 0.01, atol=1e-3)
 
+    def test_poisson_one_program_per_batch(self):
+        """Poisson (the reference default) also runs all replicas in ONE
+        program: fixed-capacity uniform resamples + concrete valid counts
+        (VERDICT r2 #5).  Trace count must not grow with the stream."""
+        rng = np.random.default_rng(21)
+        preds = jnp.asarray(rng.random((6, 128, 3), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 3, (6, 128)))
+        m = BootStrapper(
+            Accuracy(num_classes=3, validate_args=False),
+            num_bootstraps=50,
+            sampling_strategy="poisson",
+            seed=3,
+        )
+        for i in range(6):
+            m.update(preds[i], target[i])
+        assert m._vmap_active is True  # vmapped path engaged, not the loop
+        assert len(m._vmapped_update_poisson) == 1  # one program for the stream
+        out = m.compute()
+        base = Accuracy(num_classes=3, validate_args=False)
+        for i in range(6):
+            base.update(preds[i], target[i])
+        true_acc = float(base.compute())
+        assert abs(float(out["mean"]) - true_acc) < 0.05
+        assert float(out["std"]) > 0
+
+    def test_poisson_vmapped_matches_eager_loop_distribution(self):
+        """The fixed-capacity formulation is the same poisson bootstrap:
+        total N ~ Poisson(size) of iid uniform draws (process splitting)."""
+        rng = np.random.default_rng(22)
+        preds = jnp.asarray(rng.random((4, 128), dtype=np.float32))
+        target = preds + jnp.asarray(rng.normal(0, 0.3, (4, 128)).astype(np.float32))
+        stats = {}
+        for mode in ("vmapped", "eager"):
+            m = BootStrapper(MeanSquaredError(), num_bootstraps=64, sampling_strategy="poisson", seed=7)
+            if mode == "eager":
+                m._vmap_active = False
+            for i in range(4):
+                m.update(preds[i], target[i])
+            assert m._vmap_active is (mode == "vmapped")
+            out = m.compute()
+            stats[mode] = (float(out["mean"]), float(out["std"]))
+        assert abs(stats["vmapped"][0] - stats["eager"][0]) < 0.01
+        assert abs(stats["vmapped"][1] - stats["eager"][1]) < 0.01
+
+    def test_poisson_vmapped_tiny_batch_empty_replicas(self):
+        m = BootStrapper(MeanSquaredError(), num_bootstraps=50, sampling_strategy="poisson", seed=5)
+        m.update(jnp.asarray([1.0]), jnp.asarray([2.0]))  # ~37% of replicas draw empty
+        out = m.compute()
+        assert np.isfinite(float(out["mean"]))
+        assert np.isfinite(float(out["std"]))
+
     @pytest.mark.parametrize("base_cls", ["auroc", "prc"])
     def test_buffer_state_base_falls_back_to_clone_loop(self, base_cls):
         """Buffer-state base metrics (curve family) cannot stack: the vmapped
