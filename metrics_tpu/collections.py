@@ -82,6 +82,11 @@ class MetricCollection:
                 f"Metric name {name!r} occurs twice; use distinct mapping keys"
                 " to disambiguate instances of one class"
             )
+        # the collection reads member state directly (group detection, state
+        # sharing) and has its own fused dispatch paths — per-metric lazy
+        # accumulation must not run underneath it
+        metric._flush_pending()
+        metric.lazy_updates = 0
         self._modules[name] = metric
 
     def add_metrics(

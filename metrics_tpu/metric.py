@@ -28,7 +28,7 @@ import functools
 import numbers
 import warnings
 from abc import ABC, abstractmethod
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -128,6 +128,15 @@ class Metric(ABC):
             references to *pre-update* state arrays become invalid after the
             next update; ``MetricCollection`` turns donation off for metrics
             whose state it shares across a compute group.
+        lazy_updates: accumulate up to this many eager ``update`` calls
+            host-side and fold them through ``update_batched`` in ONE scan
+            dispatch (default 64; 0 disables).  Per-update host dispatch —
+            not FLOPs — bounds a streaming loop on accelerators, so the
+            reference-shaped ``metric.update(batch)`` loop batches its
+            dispatches automatically.  Every state read (``compute``,
+            ``sync``, ``state_dict``, attribute access, pickling) flushes
+            first, so results are indistinguishable from immediate updates;
+            input validation and mode-locking still run eagerly per call.
     """
 
     __jit_state_unsafe__ = False  # set True on metrics whose update cannot trace
@@ -161,8 +170,15 @@ class Metric(ABC):
         self.jit_compute = kwargs.pop("jit_compute", self.jit_compute_default)
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         self.donate_state = kwargs.pop("donate_state", True)
+        self.lazy_updates = kwargs.pop("lazy_updates", 64)
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
+        # lazy-update accumulator: eager `update` calls append here and flush
+        # through `update_batched` (one scan dispatch per `lazy_updates`
+        # batches) at the threshold or at any state read
+        self._pending: List[Tuple[tuple, dict]] = []
+        self._pending_sig: Any = None
+        self._jitted_stack: Optional[Callable] = None
 
         self._update_count = 0
         self._computed: Any = None
@@ -398,6 +414,7 @@ class Metric(ABC):
 
     def buffer_values(self, name: str) -> Array:
         """The valid rows of buffer state ``name`` (compute-side accessor)."""
+        self._flush_pending()
         return self._extract_buffer_values(self._state, name)
 
     def _refresh_buffer_meta(self, name: str) -> None:
@@ -425,6 +442,11 @@ class Metric(ABC):
     def __getattr__(self, name: str) -> Any:
         state = self.__dict__.get("_state")
         if state is not None and name in state:
+            # state reads must see every update
+            if self.__dict__.get("_pending"):
+                self._flush_pending()
+            if self.__dict__.get("_host_buffers_dirty"):
+                self._flush_host_buffers()
             return state[name]
         raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
@@ -435,9 +457,17 @@ class Metric(ABC):
         else:
             object.__setattr__(self, name, value)
 
+    def _flush_host_buffers(self) -> None:
+        """Subclass hook: fold host-side accumulation buffers (e.g. FID's
+        ``extractor_batch`` image queue) into state.  Called at every READ
+        surface — unlike :meth:`_flush_pending`, never at update entry, so
+        accumulation survives across update calls."""
+
     @property
     def state(self) -> Dict[str, Any]:
         """The raw state pytree (orbax-serializable when no list states are pending)."""
+        self._flush_pending()
+        self._flush_host_buffers()
         return self._state
 
     def _has_list_state(self) -> bool:
@@ -445,7 +475,7 @@ class Metric(ABC):
 
     @property
     def update_count(self) -> int:
-        return self._update_count
+        return self._update_count + len(self._pending)
 
     # ----------------------------------------------------------- pure kernels
     def init_state(self) -> Dict[str, Any]:
@@ -502,6 +532,8 @@ class Metric(ABC):
                 back to the unweighted two-way average (the reference's
                 stack->mean has the same equal-shard assumption).
         """
+        self._flush_pending()
+        self._flush_host_buffers()
         if other_count is not None:
             mine, theirs = float(self._update_count), float(other_count)
             total = mine + theirs
@@ -630,7 +662,110 @@ class Metric(ABC):
         lock their mode here so the traced body stays shape-static.
         """
 
+    def _lazy_signature(self, args: tuple, kwargs: dict) -> Any:
+        """Accumulation key: tree structure + array shapes/dtypes + concrete
+        values of non-array leaves (which pass through a flush un-stacked, so
+        they must be identical across the pending run).  ``None`` = this call
+        cannot accumulate."""
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig, has_batch = [], False
+        for leaf in leaves:
+            if hasattr(leaf, "ndim") and hasattr(leaf, "shape"):
+                if leaf.ndim == 0:
+                    return None  # 0-d array: comparing values costs a device pull
+                has_batch = True
+                sig.append(("a", leaf.shape, str(leaf.dtype)))
+            else:
+                try:
+                    hash(leaf)
+                except TypeError:
+                    return None
+                sig.append(("s", leaf))
+        if not has_batch:
+            return None
+        return (treedef, tuple(sig))
+
+    def _lazy_append(self, args: tuple, kwargs: dict) -> bool:
+        sig = self._lazy_signature(args, kwargs)
+        if sig is None or not self._can_jit(args, kwargs):
+            return False
+        if self._pending and sig != self._pending_sig:
+            self._flush_pending()
+        # validation and mode-locking keep their eager per-call timing
+        self._pre_update(*args, **kwargs)
+        # COPY mutable host arrays: dataloaders commonly reuse preallocated
+        # batch buffers, and a deferred flush must see each batch's values at
+        # call time, not the buffer's final contents (device arrays are
+        # immutable — only numpy needs the copy)
+        args, kwargs = jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True) if isinstance(x, np.ndarray) else x,
+            (args, kwargs),
+        )
+        self._pending.append((args, kwargs))
+        self._pending_sig = sig
+        self._computed = None
+        if len(self._pending) >= self.lazy_updates:
+            self._flush_pending()
+        return True
+
+    def _flush_pending(self) -> None:
+        """Fold every pending lazy update into state.
+
+        A FULL accumulator (threshold reached) flushes as ONE ``lax.scan``
+        dispatch — the stack shape is always ``lazy_updates``, so the scan
+        program compiles once per input signature.  Partial flushes (forced
+        by a state read, a signature change, or ``compute`` at epoch end)
+        run the direct per-update path instead: they happen rarely, and
+        compiling a fresh scan for every distinct partial length would cost
+        far more than the handful of dispatches it saves.
+        """
+        pending = self.__dict__.get("_pending")
+        if not pending:
+            return
+        self._pending = []
+        self._pending_sig = None
+        if len(pending) < self.lazy_updates:
+            for args, kwargs in pending:
+                self._update_now(*args, **kwargs)
+            return
+        leaves_list = [jax.tree_util.tree_flatten((a, k))[0] for a, k in pending]
+        treedef = jax.tree_util.tree_flatten(pending[0])[1]
+        stacked: List[Any] = []
+        device_cols = []  # (position, values) stacked in ONE compiled program
+        for vals in zip(*leaves_list):
+            v0 = vals[0]
+            if hasattr(v0, "ndim") and hasattr(v0, "shape"):
+                if all(isinstance(v, np.ndarray) for v in vals):
+                    stacked.append(np.stack(vals))  # one host->device transfer
+                else:
+                    device_cols.append((len(stacked), vals))
+                    stacked.append(None)
+            else:
+                stacked.append(v0)  # identical across pending (signature)
+        if device_cols:
+            # eager jnp.stack dispatches one expand op PER ELEMENT; a jitted
+            # stack is a single dispatch for every column at once
+            if self._jitted_stack is None:
+                self._jitted_stack = jax.jit(
+                    lambda cols: tuple(jnp.stack(c) for c in cols)
+                )
+            outs = self._jitted_stack(tuple(vals for _, vals in device_cols))
+            for (pos, _), out in zip(device_cols, outs):
+                stacked[pos] = out
+        s_args, s_kwargs = jax.tree_util.tree_unflatten(treedef, stacked)
+        self.update_batched(*s_args, **s_kwargs)
+
     def _update_wrapper(self, *args: Any, **kwargs: Any) -> None:
+        if self._is_synced:
+            raise MetricsTPUUserError(
+                "The Metric has already been synced; re-syncing or updating while synced is forbidden."
+            )
+        if self.lazy_updates and self._lazy_append(args, kwargs):
+            return
+        self._flush_pending()  # ineligible call: keep stream order
+        self._update_now(*args, **kwargs)
+
+    def _update_now(self, *args: Any, **kwargs: Any) -> None:
         if self._is_synced:
             raise MetricsTPUUserError(
                 "The Metric has already been synced; re-syncing or updating while synced is forbidden."
@@ -708,6 +843,7 @@ class Metric(ABC):
         unchanged to every slice.  Falls back to the per-slice Python loop for
         list states and non-jittable inputs.
         """
+        self._flush_pending()  # earlier lazy updates come first in the stream
         all_leaves, treedef, is_batched, statics, n, ragged = _flatten_batched_inputs(args, kwargs)
         if n is None:
             raise MetricsTPUUserError(
@@ -731,7 +867,7 @@ class Metric(ABC):
         def _loop_fallback(start: int = 0) -> None:
             for i in range(start, n):
                 sl_args, sl_kwargs = _slice(i)
-                self._update_wrapper(*sl_args, **sl_kwargs)
+                self._update_now(*sl_args, **sl_kwargs)
 
         if not self._can_jit(args, kwargs):
             _loop_fallback()
@@ -749,7 +885,7 @@ class Metric(ABC):
             buffer_rows = self._buffer_rows_by_sig.get(sig)
             if buffer_rows is None:
                 # record per-slice rows on the first slice, then scan the rest
-                self._update_wrapper(*first_args, **first_kwargs)
+                self._update_now(*first_args, **first_kwargs)
                 buffer_rows = self._buffer_rows_by_sig.get(sig)
                 if buffer_rows is None:  # body turned out untraceable
                     _loop_fallback(start=1)
@@ -838,6 +974,8 @@ class Metric(ABC):
         """
         if self._is_synced:
             raise MetricsTPUUserError("Calling forward while the metric is synced is forbidden.")
+        self._flush_pending()  # the merge base must hold every prior update
+        self._flush_host_buffers()
         # custom callables and None-reduce *tensor* states have no O(1) merge
         # rule — route them through the slow re-update path (the reference
         # stacks them, which grows state shape every step; re-running update is
@@ -921,11 +1059,11 @@ class Metric(ABC):
         self.reset()
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
-        self._update_wrapper(*args, **kwargs)
+        self._update_now(*args, **kwargs)
         cache = self._copy_state()
         cached_count = self._update_count
         self._reset_for_forward()
-        self._update_wrapper(*args, **kwargs)
+        self._update_now(*args, **kwargs)
         should_sync = self.dist_sync_on_step
         prev_sync = self.sync_on_compute
         self.sync_on_compute = should_sync
@@ -943,7 +1081,7 @@ class Metric(ABC):
         global_state = self._copy_state()
         global_count = self._update_count
         self._reset_for_forward()
-        self._update_wrapper(*args, **kwargs)
+        self._update_now(*args, **kwargs)
         prev_sync = self.sync_on_compute
         self.sync_on_compute = False
         try:
@@ -994,6 +1132,7 @@ class Metric(ABC):
 
     # ----------------------------------------------------------------- sync
     def _copy_state(self) -> Dict[str, Any]:
+        self._flush_pending()
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
 
     def _restore_state(self, cache: Dict[str, Any]) -> None:
@@ -1011,6 +1150,8 @@ class Metric(ABC):
         """Gather + reduce state across participants (reference ``metric.py:408-442``)."""
         if self._is_synced:
             raise MetricsTPUUserError("The Metric has already been synced.")
+        self._flush_pending()
+        self._flush_host_buffers()
         backend = get_backend(self.axis_name)
         if distributed_available is None:
             distributed_available = backend.is_distributed()
@@ -1058,6 +1199,8 @@ class Metric(ABC):
 
     # ---------------------------------------------------------------- compute
     def _compute_wrapper(self) -> Any:
+        self._flush_pending()
+        self._flush_host_buffers()
         if self._update_count == 0 and not self._update_called_warned:
             rank_zero_warn(
                 f"The ``compute`` method of metric {type(self).__name__} was called before the "
@@ -1103,6 +1246,8 @@ class Metric(ABC):
     # ------------------------------------------------------------------ reset
     def reset(self) -> None:
         """Reset state to defaults (reference ``metric.py:539-554``)."""
+        self._pending = []  # pending lazy updates are part of the cleared epoch
+        self._pending_sig = None
         self._update_count = 0
         self._computed = None
         self._cache = None
@@ -1137,6 +1282,7 @@ class Metric(ABC):
 
     def set_dtype(self, dst_type: Any) -> "Metric":
         """Cast floating states (reference ``metric.py:588-614``)."""
+        self._flush_pending()
         self._dtype = dst_type
 
         def cast(v: Array) -> Array:
@@ -1171,6 +1317,8 @@ class Metric(ABC):
 
     def state_dict(self, keep_vars: bool = False) -> Dict[str, Any]:
         """Snapshot persistent states as numpy (reference ``metric.py:654-672``)."""
+        self._flush_pending()
+        self._flush_host_buffers()
         out: Dict[str, Any] = {}
         for name, value in self._state.items():
             if not self._persistent[name]:
@@ -1196,6 +1344,8 @@ class Metric(ABC):
     def state_pytree(self) -> Dict[str, Any]:
         """Full state as an orbax-serializable pytree (list states pre-concatenated,
         buffer states trimmed to their valid rows)."""
+        self._flush_pending()
+        self._flush_host_buffers()
         out: Dict[str, Any] = {"_update_count": self._update_count}
         for name, value in self._state.items():
             out[name] = dim_zero_cat(value) if isinstance(value, list) and value else value
@@ -1219,6 +1369,8 @@ class Metric(ABC):
 
     # ------------------------------------------------------------- pickling
     def __getstate__(self) -> Dict[str, Any]:
+        self._flush_pending()
+        self._flush_host_buffers()
         d = self.__dict__.copy()
         # bound-method wrappers are reinstalled in __setstate__
         for key in ("update", "compute", "_update_impl", "_compute_impl"):
@@ -1227,6 +1379,7 @@ class Metric(ABC):
         d["_jitted_update_batched"] = None
         d["_jitted_compute"] = None
         d["_jitted_forward"] = None
+        d["_jitted_stack"] = None
         d["_state"] = {
             k: (
                 [np.asarray(x) for x in v]

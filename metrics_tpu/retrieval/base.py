@@ -69,6 +69,19 @@ class RetrievalMetric(Metric):
         self.add_buffer_state("preds")
         self.add_buffer_state("target")
 
+    def _pre_update(self, preds: Array = None, target: Array = None, indexes: Array = None) -> None:
+        """Eager validation on concrete inputs (errors keep their per-call
+        timing even when the update itself is lazily accumulated)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        _check_retrieval_inputs(
+            indexes,
+            preds,
+            target,
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         """Validate, flatten and append the batch (reference ``base.py:97-108``)."""
         if indexes is None:
@@ -79,6 +92,9 @@ class RetrievalMetric(Metric):
             target,
             allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,
+            # the wrapper path (swapped=False) already validated in
+            # _pre_update; the pure apply_update path validates here
+            validate_args=self._state_swapped,
         )
         self._buffer_append("indexes", indexes)
         self._buffer_append("preds", preds)

@@ -106,7 +106,7 @@ class TestNoRetracing:
     per input signature' — streaming batches must not retrace."""
 
     def test_update_traces_once_for_same_shapes(self):
-        m = Accuracy(num_classes=4, validate_args=False)
+        m = Accuracy(num_classes=4, validate_args=False, lazy_updates=0)
         for _ in range(5):
             preds = jnp.asarray(_rng.random((16, 4), dtype=np.float32))
             target = jnp.asarray(_rng.integers(0, 4, 16))
@@ -115,10 +115,98 @@ class TestNoRetracing:
         assert m._jitted_update._cache_size() == 1
 
     def test_new_shape_adds_single_trace(self):
-        m = MeanSquaredError()
+        m = MeanSquaredError(lazy_updates=0)
         for n in (8, 8, 16, 16, 8):
             m.update(jnp.ones(n), jnp.zeros(n))
         assert m._jitted_update._cache_size() == 2
+
+
+class TestLazyUpdates:
+    """Default eager `update` calls accumulate host-side and flush through
+    ONE `update_batched` scan dispatch (VERDICT r2 #4: the reference-shaped
+    per-batch loop must not pay one device dispatch per update)."""
+
+    def test_accumulates_then_flushes_one_program(self):
+        m = Accuracy(num_classes=4, validate_args=False, lazy_updates=16)
+        preds = jnp.asarray(_rng.random((20, 64, 4), dtype=np.float32))
+        target = jnp.asarray(_rng.integers(0, 4, (20, 64)))
+        for i in range(10):
+            m.update(preds[i], target[i])
+        assert len(m._pending) == 10  # below threshold: no dispatch yet
+        assert m._jitted_update is None
+        assert m.update_count == 10  # but the count is live
+        for i in range(10, 20):
+            m.update(preds[i], target[i])
+        assert len(m._pending) == 4  # 16 flushed at the threshold
+        val = float(m.compute())  # compute flushes the rest
+        assert not m._pending
+        ref = Accuracy(num_classes=4, validate_args=False, lazy_updates=0)
+        ref.update_batched(preds, target)
+        assert abs(val - float(ref.compute())) < 1e-6
+        assert Accuracy(num_classes=4).lazy_updates == 64  # accumulation is the default
+
+    def test_reused_input_buffer_is_copied(self):
+        """Dataloaders commonly reuse a preallocated batch buffer; pending
+        lazy updates must hold each batch's VALUES, not buffer references."""
+        rng = np.random.default_rng(40)
+        all_p, all_t = [], []
+        m = Accuracy(num_classes=4, validate_args=False)
+        buf_p = np.empty((64, 4), np.float32)
+        buf_t = np.empty(64, np.int64)
+        for _ in range(8):
+            buf_p[:] = rng.random((64, 4))
+            buf_t[:] = rng.integers(0, 4, 64)
+            all_p.append(buf_p.copy())
+            all_t.append(buf_t.copy())
+            m.update(buf_p, buf_t)  # same buffer object every call
+        ref = Accuracy(num_classes=4, validate_args=False, lazy_updates=0)
+        for p, t in zip(all_p, all_t):
+            ref.update(p, t)
+        assert abs(float(m.compute()) - float(ref.compute())) < 1e-6
+
+    def test_state_attribute_read_flushes(self):
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        m.update(jnp.ones(8), jnp.zeros(8))
+        assert len(m._pending) == 2
+        assert float(m.total) == 16.0  # attribute read sees every update
+        assert not m._pending
+
+    def test_signature_change_flushes_in_order(self):
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        m.update(jnp.ones(16), jnp.full(16, 3.0))  # new shape: prior flushes
+        assert np.isclose(float(m.compute()), (8 * 1 + 16 * 4) / 24)
+
+    def test_reset_drops_pending(self):
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        m.reset()
+        m.update(jnp.ones(8), jnp.full(8, 4.0))
+        assert np.isclose(float(m.compute()), 9.0)
+
+    def test_pickle_flushes(self):
+        import pickle
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        clone = pickle.loads(pickle.dumps(m))
+        assert np.isclose(float(clone.compute()), 1.0)
+
+    def test_forward_sees_pending(self):
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        m(jnp.ones(8), jnp.full(8, 2.0))  # forward must merge onto flushed state
+        assert np.isclose(float(m.compute()), 1.0)
+
+    def test_mode_lock_still_eager_per_call(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        m = Accuracy(num_classes=None)
+        m.update(jnp.asarray([0.1, 0.9, 0.4]), jnp.asarray([0, 1, 0]))  # binary probs
+        with pytest.raises(Exception):
+            # mid-stream switch to multiclass input must raise AT the call
+            m.update(jnp.asarray(_rng.random((3, 4), dtype=np.float32)), jnp.asarray([0, 1, 2]))
 
 
 class TestBufferedCurveStates:
@@ -134,7 +222,7 @@ class TestBufferedCurveStates:
     def test_no_per_batch_retrace(self):
         from metrics_tpu.classification import PrecisionRecallCurve
 
-        m = PrecisionRecallCurve()
+        m = PrecisionRecallCurve(lazy_updates=0)
         self._stream(m, 40)  # 640 rows: grows 256 -> 512 -> 1024
         assert m._jitted_update is not None
         # one eager recording run, then one trace per capacity (256/512/1024)
@@ -144,7 +232,7 @@ class TestBufferedCurveStates:
     def test_memory_is_one_padded_buffer(self):
         from metrics_tpu.classification import PrecisionRecallCurve
 
-        m = PrecisionRecallCurve()
+        m = PrecisionRecallCurve(lazy_updates=0)
         self._stream(m, 40)
         buf = m._state["preds__buf"]
         assert buf.shape[0] == 1024  # pow2 ≥ 640, not one array per batch
@@ -176,7 +264,7 @@ class TestBufferedCurveStates:
     def test_capacity_survives_reset_no_retrace(self):
         from metrics_tpu.classification import PrecisionRecallCurve
 
-        m = PrecisionRecallCurve()
+        m = PrecisionRecallCurve(lazy_updates=0)
         self._stream(m, 20)
         traces_before = m._jitted_update._cache_size()
         m.reset()

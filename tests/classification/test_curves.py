@@ -196,6 +196,6 @@ def test_binned_recall_at_fixed_precision():
 
 def test_binned_jits():
     """The binned curve update must run through the jitted path (fixed shapes)."""
-    m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=10)
+    m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=10, lazy_updates=0)
     m.update(jnp.asarray(MC.preds[0]), jnp.asarray(MC.target[0]))
     assert m._jitted_update is not None

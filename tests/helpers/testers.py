@@ -178,6 +178,7 @@ class MetricTester:
                 # one eager update locks mode/num_classes attrs, then the
                 # state is replaced wholesale by the synced one
                 m.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+                m._flush_pending()  # state surgery below must not race a lazy update
                 rank_state = jax.tree_util.tree_map(lambda x: x[r], synced_state)
                 for key, val in rank_state.items():
                     m._state[key] = val if not isinstance(m._state[key], list) else [val]
