@@ -88,6 +88,27 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_uint8),
         ]
+        lib.mtpu_box_iou_blocks.restype = None
+        lib.mtpu_box_iou_blocks.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.mtpu_rle_iou_blocks.restype = None
+        lib.mtpu_rle_iou_blocks.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.mtpu_coco_match_blocks.restype = None
+        lib.mtpu_coco_match_blocks.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+        ]
         return lib
     except Exception:
         return None
@@ -222,6 +243,100 @@ def coco_match(ious: np.ndarray, gt_ignore: np.ndarray, thresholds: np.ndarray):
         gt_matched.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
     return det_match, det_ignore.astype(bool), gt_matched.astype(bool)
+
+
+def _i64(x: np.ndarray):
+    return np.ascontiguousarray(x, dtype=np.int64)
+
+
+def box_iou_blocks(dboxes: np.ndarray, nd: np.ndarray, gboxes: np.ndarray, ng: np.ndarray):
+    """Pairwise IoU for B independent xyxy blocks in one native call.
+
+    Args: dboxes (sum_nd, 4) and gboxes (sum_ng, 4) float64 concatenated in
+    block order; nd/ng (B,) per-block counts.  Returns the flat concatenation
+    of row-major (nd[b], ng[b]) blocks, or None if no native lib.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    nd, ng = _i64(nd), _i64(ng)
+    dboxes = np.ascontiguousarray(dboxes, dtype=np.float64)
+    gboxes = np.ascontiguousarray(gboxes, dtype=np.float64)
+    out = np.empty(int((nd * ng).sum()), dtype=np.float64)
+    lib.mtpu_box_iou_blocks(
+        dboxes.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        nd.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        gboxes.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ng.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(nd),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out
+
+
+def rle_iou_blocks(
+    druns: np.ndarray, drunlens: np.ndarray, gruns: np.ndarray, grunlens: np.ndarray,
+    nd: np.ndarray, ng: np.ndarray,
+):
+    """Pairwise RLE-mask IoU for B independent blocks in one native call.
+
+    Args: druns/gruns — all masks' uint32 run arrays concatenated in block
+    order; drunlens/grunlens — per-mask run counts; nd/ng — masks per block.
+    Returns the flat (nd[b], ng[b]) block concatenation, or None.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    nd, ng = _i64(nd), _i64(ng)
+    druns = np.ascontiguousarray(druns, dtype=np.uint32)
+    gruns = np.ascontiguousarray(gruns, dtype=np.uint32)
+    drunlens, grunlens = _i64(drunlens), _i64(grunlens)
+    out = np.empty(int((nd * ng).sum()), dtype=np.float64)
+    lib.mtpu_rle_iou_blocks(
+        druns.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        drunlens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        gruns.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        grunlens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        nd.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ng.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(nd),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out
+
+
+def coco_match_blocks(
+    ious_flat: np.ndarray, nd: np.ndarray, ng: np.ndarray,
+    gt_ignore: np.ndarray, thresholds: np.ndarray,
+):
+    """Greedy COCO matching for B independent blocks in one native call.
+
+    Args: ious_flat — concatenated row-major (nd[b], ng[b]) blocks; gt_ignore
+    — concatenated per-gt flags in block order; thresholds (T,).  Returns
+    codes (T, sum_nd) uint8 (0 unmatched / 1 matched counted / 2 matched
+    ignored) with block b's columns at its running det offset, or None.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    nd, ng = _i64(nd), _i64(ng)
+    ious_flat = np.ascontiguousarray(ious_flat, dtype=np.float64)
+    gt_ignore = np.ascontiguousarray(gt_ignore, dtype=np.uint8)
+    thresholds = np.ascontiguousarray(thresholds, dtype=np.float64)
+    total_det = int(nd.sum())
+    codes = np.empty((len(thresholds), total_det), dtype=np.uint8)
+    lib.mtpu_coco_match_blocks(
+        ious_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        nd.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ng.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(nd),
+        gt_ignore.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        thresholds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(thresholds),
+        total_det,
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return codes
 
 
 # ---------------------------------------------------------------------------

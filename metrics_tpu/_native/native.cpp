@@ -138,6 +138,133 @@ void mtpu_coco_match(const double* ious, int64_t n_det, int64_t n_gt,
     }
 }
 
+// Pairwise IoU for independent xyxy box blocks in one call (the per-
+// (image,class) IoU blocks of COCO mAP).  dboxes/gboxes are the
+// concatenated (sum_nd, 4)/(sum_ng, 4) tables; out receives the
+// concatenated row-major nd[b] x ng[b] blocks.
+void mtpu_box_iou_blocks(const double* dboxes, const int64_t* nd,
+                         const double* gboxes, const int64_t* ng,
+                         int64_t n_blocks, double* out) {
+    int64_t d_off = 0, g_off = 0, o_off = 0;
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        const double* D = dboxes + d_off * 4;
+        const double* G = gboxes + g_off * 4;
+        for (int64_t i = 0; i < nd[b]; ++i) {
+            const double dx1 = D[i * 4], dy1 = D[i * 4 + 1];
+            const double dx2 = D[i * 4 + 2], dy2 = D[i * 4 + 3];
+            const double da = (dx2 - dx1) * (dy2 - dy1);
+            double* row = out + o_off + i * ng[b];
+            for (int64_t j = 0; j < ng[b]; ++j) {
+                const double gx1 = G[j * 4], gy1 = G[j * 4 + 1];
+                const double gx2 = G[j * 4 + 2], gy2 = G[j * 4 + 3];
+                const double w = std::min(dx2, gx2) - std::max(dx1, gx1);
+                const double h = std::min(dy2, gy2) - std::max(dy1, gy1);
+                const double inter = (w > 0 && h > 0) ? w * h : 0.0;
+                const double ga = (gx2 - gx1) * (gy2 - gy1);
+                const double uni = da + ga - inter;
+                row[j] = uni > 0 ? inter / std::max(uni, 1e-12) : 0.0;
+            }
+        }
+        d_off += nd[b];
+        g_off += ng[b];
+        o_off += nd[b] * ng[b];
+    }
+}
+
+// Pairwise RLE-mask IoU for independent blocks (segm mAP).  druns/gruns are
+// every mask's run array concatenated in block order; drunlens/grunlens give
+// each mask's run count; nd/ng give the masks per block.  Output layout
+// matches mtpu_box_iou_blocks.
+void mtpu_rle_iou_blocks(const uint32_t* druns, const int64_t* drunlens,
+                         const uint32_t* gruns, const int64_t* grunlens,
+                         const int64_t* nd, const int64_t* ng, int64_t n_blocks,
+                         double* out) {
+    int64_t dmask = 0, gmask = 0, o = 0;
+    int64_t droff = 0, groff = 0;
+    std::vector<int64_t> d_start, g_start, d_area, g_area;
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        d_start.assign(nd[b], 0); d_area.assign(nd[b], 0);
+        g_start.assign(ng[b], 0); g_area.assign(ng[b], 0);
+        for (int64_t i = 0; i < nd[b]; ++i) {
+            d_start[i] = droff;
+            d_area[i] = mtpu_rle_area(druns + droff, drunlens[dmask + i]);
+            droff += drunlens[dmask + i];
+        }
+        for (int64_t j = 0; j < ng[b]; ++j) {
+            g_start[j] = groff;
+            g_area[j] = mtpu_rle_area(gruns + groff, grunlens[gmask + j]);
+            groff += grunlens[gmask + j];
+        }
+        for (int64_t i = 0; i < nd[b]; ++i)
+            for (int64_t j = 0; j < ng[b]; ++j) {
+                const int64_t inter = mtpu_rle_intersection(
+                    druns + d_start[i], drunlens[dmask + i],
+                    gruns + g_start[j], grunlens[gmask + j]);
+                const int64_t uni = d_area[i] + g_area[j] - inter;
+                out[o + i * ng[b] + j] = uni > 0 ? (double)inter / (double)uni : 0.0;
+            }
+        dmask += nd[b];
+        gmask += ng[b];
+        o += nd[b] * ng[b];
+    }
+}
+
+// Batched greedy COCO matching over independent (nd[b], ng[b]) IoU blocks in
+// ONE call (replaces one ctypes crossing per image x class x area).  Ground
+// truths arrive in their block-original order with per-gt ignore flags; each
+// block builds its own stable non-ignored-first visiting order.  codes is
+// (n_thr, total_det) with block b's det columns at the running det offset:
+// 0 = unmatched, 1 = matched to a counted gt, 2 = matched to an ignored gt.
+void mtpu_coco_match_blocks(const double* ious, const int64_t* nd, const int64_t* ng,
+                            int64_t n_blocks, const uint8_t* gt_ignore,
+                            const double* thresholds, int64_t n_thr,
+                            int64_t total_det, uint8_t* codes) {
+    std::vector<int64_t> order;
+    std::vector<uint8_t> gm;
+    int64_t iou_off = 0, d_off = 0, g_off = 0;
+    for (int64_t b = 0; b < n_blocks; ++b) {
+        const int64_t NDb = nd[b], NGb = ng[b];
+        const double* I = ious + iou_off;
+        const uint8_t* gig = gt_ignore + g_off;
+        order.clear();
+        for (int64_t g = 0; g < NGb; ++g)
+            if (!gig[g]) order.push_back(g);
+        const int64_t n_real = (int64_t)order.size();
+        for (int64_t g = 0; g < NGb; ++g)
+            if (gig[g]) order.push_back(g);
+        gm.assign(NGb, 0);
+        for (int64_t ti = 0; ti < n_thr; ++ti) {
+            std::fill(gm.begin(), gm.end(), 0);
+            uint8_t* C = codes + ti * total_det + d_off;
+            for (int64_t d = 0; d < NDb; ++d) {
+                double best_iou = std::min(thresholds[ti], 1.0 - 1e-10);
+                int64_t best = -1;  // position in visiting order
+                const double* row = I + d * NGb;
+                for (int64_t oi = 0; oi < NGb; ++oi) {
+                    const int64_t g = order[oi];
+                    if (gm[g]) continue;
+                    // once a counted match exists, stop at the ignored region
+                    if (best > -1 && best < n_real && oi >= n_real) break;
+                    const double v = row[g];
+                    if (v < best_iou) continue;
+                    best_iou = v;
+                    best = oi;
+                }
+                if (best == -1) {
+                    C[d] = 0;
+                    continue;
+                }
+                const int64_t g = order[best];
+                gm[g] = 1;
+                C[d] = gig[g] ? 2 : 1;
+            }
+        }
+        iou_off += NDb * NGb;
+        d_off += NDb;
+        g_off += NGb;
+    }
+}
+
 // Batched minimum-cost linear assignment (Jonker-Volgenant style shortest
 // augmenting paths with dual potentials, O(n^3) per matrix).  The audio PIT
 // metric routes large speaker counts here instead of enumerating n!

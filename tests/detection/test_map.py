@@ -337,3 +337,184 @@ class TestSegmHardening:
             res = m.compute()
         assert float(res["map"]) == -1.0
         assert float(res["mar_100"]) == -1.0
+
+
+class TestSegmIrregularDenseOracle:
+    """VERDICT r2 #7: a DIRECT (non-transitive) segm oracle.
+
+    The protocol is pinned to pycocotools by the bbox fixture; what remained
+    codec-trusted was RLE IoU on irregular masks.  These tests pin the codec
+    and the end-to-end segm result against dense-numpy references that share
+    no code with ``metrics_tpu._native``.
+    """
+
+    @staticmethod
+    def _irregular_masks(rng, n, h=96, w=128):
+        """Blobs with holes, plus pairs of touching instances."""
+        yy, xx = np.mgrid[0:h, 0:w]
+        masks = []
+        for i in range(n):
+            cy, cx = rng.integers(20, h - 20), rng.integers(20, w - 20)
+            r = rng.integers(10, 28)
+            m = ((yy - cy) ** 2 + (xx - cx) ** 2) < r**2
+            if i % 2 == 0:  # punch a hole
+                m &= ((yy - cy) ** 2 + (xx - cx) ** 2) > (r // 2) ** 2
+            if i % 3 == 0:  # attach a touching rectangle lobe
+                m |= (abs(yy - cy) < 4) & (xx >= cx) & (xx < min(w, cx + r + 10))
+            masks.append(m.astype(np.uint8))
+        return np.stack(masks)
+
+    def test_rle_roundtrip_fuzz_vs_dense(self):
+        from metrics_tpu._native import rle_area, rle_decode, rle_encode
+
+        rng = np.random.default_rng(31)
+        shapes = [(1, 1), (1, 17), (23, 1), (7, 9), (64, 48), (96, 128)]
+        for trial in range(60):
+            h, w = shapes[trial % len(shapes)]
+            p = rng.random()  # densities from almost-empty to almost-full
+            m = (rng.random((h, w)) < p).astype(np.uint8)
+            if trial == 0:
+                m[:] = 0
+            if trial == 1:
+                m[:] = 1
+            counts = rle_encode(m)
+            back = rle_decode(counts, (h, w))
+            np.testing.assert_array_equal(back, m)
+            assert rle_area(counts) == int(m.sum())
+
+    def test_rle_iou_matches_dense_numpy(self):
+        from metrics_tpu._native import rle_encode, rle_iou
+
+        rng = np.random.default_rng(32)
+        masks = self._irregular_masks(rng, 12)
+        for _ in range(40):
+            a, b = masks[rng.integers(0, 12)], masks[rng.integers(0, 12)]
+            inter = int(np.logical_and(a, b).sum())
+            union = int(np.logical_or(a, b).sum())
+            want = inter / union if union else 0.0
+            got = rle_iou(rle_encode(a), rle_encode(b))
+            assert abs(got - want) < 1e-12, (got, want)
+
+    def test_rle_iou_blocks_matches_dense_numpy(self):
+        from metrics_tpu._native import native_available, rle_encode, rle_iou_blocks
+
+        if not native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(33)
+        masks = self._irregular_masks(rng, 16)
+        nd = np.asarray([3, 0, 5, 2], np.int64)
+        ng = np.asarray([2, 4, 0, 3], np.int64)
+        d_idx = rng.integers(0, 16, int(nd.sum()))
+        g_idx = rng.integers(0, 16, int(ng.sum()))
+        d_rles = [rle_encode(masks[i]) for i in d_idx]
+        g_rles = [rle_encode(masks[i]) for i in g_idx]
+        out = rle_iou_blocks(
+            np.concatenate(d_rles), np.asarray([len(r) for r in d_rles], np.int64),
+            np.concatenate(g_rles) if g_rles else np.zeros(0, np.uint32),
+            np.asarray([len(r) for r in g_rles], np.int64),
+            nd, ng,
+        )
+        # dense reference, block by block
+        want, do, go = [], 0, 0
+        for b in range(len(nd)):
+            for i in range(nd[b]):
+                for j in range(ng[b]):
+                    a, c = masks[d_idx[do + i]], masks[g_idx[go + j]]
+                    inter = int(np.logical_and(a, c).sum())
+                    union = int(np.logical_or(a, c).sum())
+                    want.append(inter / union if union else 0.0)
+            do += nd[b]
+            go += ng[b]
+        np.testing.assert_allclose(out, np.asarray(want), atol=1e-12)
+
+    @staticmethod
+    def _dense_reference_map(preds, targets, thresholds, rec_thrs):
+        """Independent mini COCO evaluator: dense mask IoU + greedy matching
+        + 101-point interpolation, area='all' / max_det=100 cells only.
+        Pure numpy; shares no code with the metric or the native codec."""
+        classes = sorted(
+            {int(c) for p in preds for c in p["labels"]}
+            | {int(c) for t in targets for c in t["labels"]}
+        )
+        ap_per = {t: [] for t in thresholds}
+        ar_per = {t: [] for t in thresholds}
+        for cls in classes:
+            npig = sum(int((np.asarray(t["labels"]) == cls).sum()) for t in targets)
+            if npig == 0:
+                continue
+            rows = []  # (score, is_tp per threshold)
+            for p, t in zip(preds, targets):
+                d_sel = np.asarray(p["labels"]) == cls
+                g_sel = np.asarray(t["labels"]) == cls
+                d_masks = np.asarray(p["masks"])[d_sel]
+                scores = np.asarray(p["scores"])[d_sel]
+                g_masks = np.asarray(t["masks"])[g_sel]
+                order = np.argsort(-scores, kind="mergesort")[:100]
+                d_masks, scores = d_masks[order], scores[order]
+                ious = np.zeros((len(d_masks), len(g_masks)))
+                for i in range(len(d_masks)):
+                    for j in range(len(g_masks)):
+                        inter = int(np.logical_and(d_masks[i], g_masks[j]).sum())
+                        union = int(np.logical_or(d_masks[i], g_masks[j]).sum())
+                        ious[i, j] = inter / union if union else 0.0
+                for ti, thr in enumerate(thresholds):
+                    taken = np.zeros(len(g_masks), bool)
+                    for i in range(len(d_masks)):
+                        best, best_iou = -1, min(thr, 1 - 1e-10)
+                        for j in range(len(g_masks)):
+                            if taken[j] or ious[i, j] < best_iou:
+                                continue
+                            best, best_iou = j, ious[i, j]
+                        tp = best >= 0
+                        if tp:
+                            taken[best] = True
+                        rows.append((float(scores[i]), ti, tp))
+            for ti, thr in enumerate(thresholds):
+                sub = [(s, tp) for s, t_i, tp in rows if t_i == ti]
+                sub.sort(key=lambda x: -x[0])
+                tps = np.cumsum([tp for _, tp in sub], dtype=float)
+                fps = np.cumsum([not tp for _, tp in sub], dtype=float)
+                if len(sub) == 0:
+                    ap_per[thr].append(0.0)
+                    ar_per[thr].append(0.0)
+                    continue
+                rc = tps / npig
+                pr = tps / np.maximum(tps + fps, np.spacing(1))
+                ar_per[thr].append(rc[-1])
+                pr = np.maximum.accumulate(pr[::-1])[::-1]
+                inds = np.searchsorted(rc, rec_thrs, side="left")
+                q = np.zeros(len(rec_thrs))
+                valid = inds < len(pr)
+                q[valid] = pr[inds[valid]]
+                ap_per[thr].append(q.mean())
+        maps = {t: float(np.mean(v)) for t, v in ap_per.items()}
+        mars = {t: float(np.mean(v)) for t, v in ar_per.items()}
+        return {
+            "map": float(np.mean(list(maps.values()))),
+            "map_50": maps[0.5],
+            "map_75": maps[0.75],
+            "mar_100": float(np.mean(list(mars.values()))),
+        }
+
+    def test_segm_map_irregular_masks_vs_dense_reference(self):
+        rng = np.random.default_rng(34)
+        preds, targets = [], []
+        for _ in range(4):
+            gt_masks = self._irregular_masks(rng, 6)
+            gt_labels = rng.integers(0, 3, 6)
+            # detections: jittered copies of gts (shift by roll) + pure noise
+            det_masks = np.concatenate(
+                [np.roll(gt_masks, rng.integers(0, 9), axis=2), self._irregular_masks(rng, 3)]
+            )
+            det_labels = np.concatenate([gt_labels, rng.integers(0, 3, 3)])
+            scores = rng.random(9)
+            preds.append(dict(masks=det_masks, scores=scores, labels=det_labels))
+            targets.append(dict(masks=gt_masks, labels=gt_labels))
+        metric = MeanAveragePrecision(iou_type="segm")
+        metric.update(preds, targets)
+        out = metric.compute()
+        thresholds = [0.5 + 0.05 * i for i in range(10)]
+        rec_thrs = np.asarray([0.01 * i for i in range(101)])
+        want = self._dense_reference_map(preds, targets, thresholds, rec_thrs)
+        for key, val in want.items():
+            assert abs(float(out[key]) - val) < 1e-6, (key, float(out[key]), val)
