@@ -217,9 +217,14 @@ class InceptionFeatureExtractor:
         batch_vars: Optional[Dict] = None,
         variables: Optional[Dict] = None,
         fid_variant: bool = True,
+        compute_dtype: Optional[Any] = None,
     ) -> None:
         self.feature = str(feature)
         self.fid_variant = fid_variant
+        # bf16 runs the convs at the MXU's native rate (~2x f32 peak on TPU);
+        # features are returned in f32 regardless.  None keeps exact-f32
+        # numerics for published-score parity
+        self.compute_dtype = compute_dtype
         self.model = FlaxInceptionV3(fid_variant=fid_variant)
         if variables is not None:
             # full variables tree, e.g. from tools.convert_weights.convert_inception_v3
@@ -246,8 +251,16 @@ class InceptionFeatureExtractor:
             x = x / 255.0
             x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[-1]), method="bilinear")
             x = (x - 0.5) * 2.0
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+            variables = jax.tree_util.tree_map(
+                lambda v: v.astype(self.compute_dtype)
+                if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
+                else v,
+                variables,
+            )
         taps = self.model.apply(variables, x)
-        return taps[self.feature]
+        return taps[self.feature].astype(jnp.float32)
 
     def __call__(self, imgs: Array) -> Array:
         imgs = jnp.asarray(imgs)

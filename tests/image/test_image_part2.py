@@ -241,3 +241,51 @@ class TestBackboneShapes:
         imgs = (np.random.default_rng(0).random((2, 3, 32, 32)) * 255).astype(np.uint8)
         out = np.asarray(ext(imgs))
         assert out.shape == (2, 1008)
+
+
+def _flat8_extractor(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x).reshape(x.shape[0], -1)[:, :8] * 1.0
+
+
+class TestFIDExtractorBatching:
+    """`extractor_batch` buffers images host-side and runs the extractor in
+    saturating chunks (VERDICT r2 #1) — results must be exactly unchanged."""
+
+    def test_buffered_matches_unbuffered_and_saturates(self):
+        rng = np.random.default_rng(50)
+        a = rng.random((40, 2, 2, 2), dtype=np.float32)
+        b = rng.random((40, 2, 2, 2), dtype=np.float32)
+        from metrics_tpu import FrechetInceptionDistance
+
+        seen_batches = []
+
+        def recording_extractor(x):
+            seen_batches.append(x.shape[0])
+            return _flat8_extractor(x)
+
+        m1 = FrechetInceptionDistance(feature=_flat8_extractor, feature_dim=8)
+        m2 = FrechetInceptionDistance(feature=recording_extractor, feature_dim=8, extractor_batch=16)
+        for i in range(0, 40, 5):
+            for m in (m1, m2):
+                m.update(a[i : i + 5], real=True)
+                m.update(b[i : i + 5], real=False)
+        # mid-stream: the extractor only ever ran at the saturating chunk
+        assert seen_batches and all(s == 16 for s in seen_batches), seen_batches
+        np.testing.assert_allclose(float(m2.compute()), float(m1.compute()), atol=1e-5)
+        # the final partial flush at compute drains the remainder
+        assert sum(seen_batches) == 80
+
+    def test_buffer_flushes_on_state_read_and_reset(self):
+        rng = np.random.default_rng(51)
+        from metrics_tpu import FrechetInceptionDistance
+
+        m = FrechetInceptionDistance(feature=_flat8_extractor, feature_dim=8, extractor_batch=64)
+        m.update(rng.random((4, 2, 2, 2), dtype=np.float32), real=True)
+        assert float(m.real_n) == 4.0  # attribute read flushed the buffer
+        assert not m._img_buffer[True]
+        m.update(rng.random((4, 2, 2, 2), dtype=np.float32), real=True)
+        m.reset()
+        assert not m._img_buffer[True]  # reset drops buffered images
+        assert float(m.real_n) == 0.0
