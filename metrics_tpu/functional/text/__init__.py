@@ -13,8 +13,11 @@ from metrics_tpu.functional.text.ter import translation_edit_rate
 from metrics_tpu.functional.text.wer import word_error_rate
 from metrics_tpu.functional.text.wil import word_information_lost
 from metrics_tpu.functional.text.wip import word_information_preserved
+from metrics_tpu.functional.text.wordpiece import WordPieceTokenizer, build_wordpiece_vocab
 
 __all__ = [
+    "WordPieceTokenizer",
+    "build_wordpiece_vocab",
     "bert_score",
     "bleu_score",
     "char_error_rate",
