@@ -1,0 +1,134 @@
+"""Pin published-score expected values for the weights-gated parity tests.
+
+``tests/test_weights_gated.py`` compares FID/LPIPS/BERTScore computed by
+metrics_tpu (with the converted pretrained weights installed by
+``tools/fetch_weights.py``) against the REFERENCE stack's outputs on fixed
+seeded inputs.  The reference stack (torch + torch-fidelity / lpips /
+bert_score, the same dependencies the reference wires in
+``/root/reference/src/torchmetrics/image/fid.py:41-58``, ``image/lpip.py:23-43``
+and ``text/bert.py:41``) is only available on a machine with network egress,
+so the expected values live in a checked-in JSON produced by this script:
+
+    # one-command CI step on a machine with egress:
+    python -m tools.fetch_weights --all            # install converted weights
+    pip install torch-fidelity lpips bert_score    # reference oracle stack
+    python -m tools.pin_expected_scores            # writes the JSON pins
+    python -m pytest tests/test_weights_gated.py   # parity, to published stack
+
+The fixed inputs are generated HERE (both the pinning run and the tests
+import these generators) so the two stacks always score identical data.
+"""
+
+import json
+import os
+
+import numpy as np
+
+PINS_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "tests", "expected_weights_scores.json")
+
+
+def fixed_images(seed: int, n: int = 32, size: int = 299) -> np.ndarray:
+    """Deterministic uint8 NCHW image batch (smooth blobs, not white noise —
+    feature extractors produce degenerate covariances on pure noise)."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    imgs = np.zeros((n, 3, size, size), np.float32)
+    for i in range(n):
+        for c in range(3):
+            k = rng.integers(1, 5, size=2)
+            phase = rng.random(2) * 6.28
+            imgs[i, c] = 0.5 + 0.25 * (
+                np.sin(6.28 * k[0] * yy + phase[0]) * np.cos(6.28 * k[1] * xx + phase[1])
+            )
+    imgs += rng.normal(0, 0.05, imgs.shape).astype(np.float32)
+    return (np.clip(imgs, 0, 1) * 255).astype(np.uint8)
+
+
+def fixed_image_pairs(seed: int, n: int = 8, size: int = 64) -> tuple:
+    """Deterministic [-1, 1] float NCHW pairs for LPIPS."""
+    a = fixed_images(seed, n=n, size=size).astype(np.float32) / 127.5 - 1.0
+    b = fixed_images(seed + 1, n=n, size=size).astype(np.float32) / 127.5 - 1.0
+    return a, b
+
+
+def fixed_sentence_pairs() -> tuple:
+    preds = [
+        "the quick brown fox jumps over the lazy dog",
+        "a stitch in time saves nine",
+        "machine translation quality estimation remains difficult",
+        "the committee approved the annual budget on tuesday",
+    ]
+    target = [
+        "a quick brown fox leapt over the lazy dog",
+        "a stitch in time saves nine lives",
+        "estimating the quality of machine translation is hard",
+        "on tuesday the committee passed the yearly budget",
+    ]
+    return preds, target
+
+
+def main() -> int:
+    pins = {}
+    import torch  # the oracle stack is torch-based
+
+    # ---- FID-2048 via torch-fidelity's InceptionV3 (the reference extractor)
+    try:
+        from torch_fidelity.feature_extractor_inceptionv3 import FeatureExtractorInceptionV3
+
+        net = FeatureExtractorInceptionV3("inception", ["2048"])
+        net.eval()
+
+        def feats(imgs):
+            with torch.no_grad():
+                return net(torch.from_numpy(imgs))[0].numpy().astype(np.float64)
+
+        real = feats(fixed_images(0))
+        fake = feats(fixed_images(100))
+        mu1, mu2 = real.mean(0), fake.mean(0)
+        s1 = np.cov(real, rowvar=False)
+        s2 = np.cov(fake, rowvar=False)
+        import scipy.linalg
+
+        covmean = scipy.linalg.sqrtm(s1 @ s2).real
+        pins["fid_2048"] = float(((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean))
+    except Exception as err:  # noqa: BLE001
+        print(f"fid pin skipped: {err}")
+
+    # ---- LPIPS vgg/alex via the lpips package (the reference extractor)
+    for net_type in ("vgg", "alex"):
+        try:
+            import lpips
+
+            model = lpips.LPIPS(net=net_type)
+            a, b = fixed_image_pairs(7)
+            with torch.no_grad():
+                d = model(torch.from_numpy(a), torch.from_numpy(b)).reshape(-1).numpy()
+            pins[f"lpips_{net_type}"] = float(d.mean())
+        except Exception as err:  # noqa: BLE001
+            print(f"lpips {net_type} pin skipped: {err}")
+
+    # ---- BERTScore roberta-large F1 via bert_score (the reference oracle)
+    try:
+        from bert_score import score as bert_score_fn
+
+        preds, target = fixed_sentence_pairs()
+        _, _, f1 = bert_score_fn(preds, target, lang="en", model_type="roberta-large",
+                                 num_layers=17, idf=False, rescale_with_baseline=False)
+        pins["bertscore_roberta_large_f1"] = [float(x) for x in f1]
+    except Exception as err:  # noqa: BLE001
+        print(f"bertscore pin skipped: {err}")
+
+    existing = {}
+    if os.path.exists(PINS_PATH):
+        with open(PINS_PATH) as f:
+            existing = json.load(f)
+    existing.update(pins)
+    with open(PINS_PATH, "w") as f:
+        json.dump(existing, f, indent=2, sort_keys=True)
+    print(f"wrote {sorted(pins)} -> {PINS_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
