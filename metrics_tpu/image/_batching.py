@@ -1,0 +1,128 @@
+"""Host-side image-batch accumulation for extractor-backed metrics.
+
+A batch-16 forward through Inception/VGG leaves the MXU almost idle;
+metrics whose states are order-independent per-image reductions (FID's
+Gaussian moments, IS/KID feature stores, LPIPS score sums) can buffer
+incoming images host-side and run their extractor at a saturating chunk
+size without changing any result.  The reference runs its extractors at
+the caller's batch size (``/root/reference/src/torchmetrics/image/fid.py:41-58``).
+
+Metrics mix in :class:`ChunkedExtractorMixin`, call ``_init_chunking`` in
+``__init__``, route updates through ``_push_or_ingest`` and implement
+``_ingest_chunk(key, imgs)``.  The base ``Metric`` read surfaces call
+``_flush_host_buffers`` so buffered images are always folded in before any
+state is observed.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class ChunkedImageQueue:
+    """Per-key queues drained in fixed-size chunks (one concatenation per
+    drain, so large pushes stay linear in bytes copied).  Device arrays are
+    queued as-is (immutable; no device->host pull); mutable numpy batches
+    are COPIED at push — dataloaders reuse preallocated buffers, and a
+    deferred drain must see call-time values."""
+
+    def __init__(self, chunk: int) -> None:
+        self.chunk = int(chunk)
+        self._bufs: Dict[Any, List[Any]] = {}
+
+    def push(self, key: Any, imgs: Any) -> List[Any]:
+        """Queue a batch; returns any now-complete chunks."""
+        if isinstance(imgs, np.ndarray):
+            imgs = np.array(imgs, copy=True)
+        elif not hasattr(imgs, "shape"):
+            imgs = np.asarray(imgs)
+        if imgs.shape[0] == 0:
+            return []  # empty batches must not wedge the pending flag
+        self._bufs.setdefault(key, []).append(imgs)
+        return self._take(key, partial=False)
+
+    def drain(self, key: Any) -> List[Any]:
+        """Empty the queue for ``key`` (the final chunk may be partial)."""
+        return self._take(key, partial=True)
+
+    def _take(self, key: Any, partial: bool) -> List[Any]:
+        buf = self._bufs.get(key, [])
+        total = sum(b.shape[0] for b in buf)
+        if total == 0:
+            self._bufs[key] = []
+            return []
+        if not partial and total < self.chunk:
+            return []
+        if len(buf) == 1:
+            cat = buf[0]
+        elif all(isinstance(b, np.ndarray) for b in buf):
+            cat = np.concatenate(buf, axis=0)
+        else:
+            import jax.numpy as jnp
+
+            cat = jnp.concatenate([jnp.asarray(b) for b in buf], axis=0)
+        out, off = [], 0
+        while total - off >= self.chunk:
+            out.append(cat[off : off + self.chunk])
+            off += self.chunk
+        if partial and off < total:
+            out.append(cat[off:])
+            off = total
+        self._bufs[key] = [cat[off:]] if off < total else []
+        return out
+
+    @property
+    def pending(self) -> bool:
+        return any(len(b) for b in self._bufs.values())
+
+    def keys(self):
+        return list(self._bufs)
+
+    def clear(self) -> None:
+        self._bufs = {}
+
+
+class ChunkedExtractorMixin:
+    """Metric mixin wiring a :class:`ChunkedImageQueue` into the read-flush
+    protocol.  Subclasses implement ``_ingest_chunk(key, imgs)``."""
+
+    def _init_chunking(self, extractor_batch: Optional[int]) -> None:
+        self.extractor_batch = extractor_batch
+        self._queue: Optional[ChunkedImageQueue] = (
+            ChunkedImageQueue(extractor_batch) if extractor_batch else None
+        )
+
+    def _ingest_chunk(self, key: Any, imgs: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def _push_or_ingest(self, key: Any, imgs: Any) -> None:
+        if self._queue is None:
+            self._ingest_chunk(key, imgs)
+            return
+        self._host_buffers_dirty = True
+        # guard: _ingest_chunk's state reads re-enter __getattr__, whose
+        # dirty-flag flush is exactly what is already running here
+        self._flushing_images = True
+        try:
+            for chunk in self._queue.push(key, imgs):
+                self._ingest_chunk(key, chunk)
+        finally:
+            self._flushing_images = False
+        self._host_buffers_dirty = self._queue.pending
+
+    def _flush_host_buffers(self) -> None:
+        if getattr(self, "_queue", None) is None or getattr(self, "_flushing_images", False):
+            return
+        self._flushing_images = True
+        try:
+            for key in self._queue.keys():
+                for chunk in self._queue.drain(key):
+                    self._ingest_chunk(key, chunk)
+        finally:
+            self._flushing_images = False
+        self._host_buffers_dirty = self._queue.pending
+
+    def _reset_chunking(self) -> None:
+        if getattr(self, "_queue", None) is not None:
+            self._queue.clear()
+        self._host_buffers_dirty = False

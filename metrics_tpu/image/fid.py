@@ -15,12 +15,12 @@ Two TPU-first redesigns over the reference:
   float64; enable ``jax_enable_x64`` for reference-grade precision).
 """
 
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Any, Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from metrics_tpu.image._batching import ChunkedExtractorMixin
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -53,7 +53,7 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
     return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 
-class FrechetInceptionDistance(Metric):
+class FrechetInceptionDistance(ChunkedExtractorMixin, Metric):
     """Streaming FID over a pluggable feature extractor.
 
     Args:
@@ -90,8 +90,7 @@ class FrechetInceptionDistance(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.extractor_batch = extractor_batch
-        self._img_buffer: Dict[bool, list] = {True: [], False: []}
+        self._init_chunking(extractor_batch)
         if isinstance(feature, int):
             from metrics_tpu.image.backbones.inception import VALID_FEATURE_DIMS
             from metrics_tpu.image.backbones.weights import make_inception_extractor
@@ -133,16 +132,15 @@ class FrechetInceptionDistance(Metric):
         self.add_state("fake_n", default=jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, imgs: Array, real: bool) -> None:
-        if self.extractor_batch:
-            # host-side accumulation: the extractor runs at a saturating
-            # chunk size instead of the caller's per-step batch.  FID's
-            # states are order-independent per-image sums, so buffering per
-            # flag preserves semantics exactly; any state read flushes first
-            self._img_buffer[bool(real)].append(np.asarray(imgs))
-            self._host_buffers_dirty = True
-            self._drain_buffer(bool(real), keep_partial=True)
-            return
-        self._ingest(imgs, real)
+        # with extractor_batch set, images accumulate host-side and the
+        # extractor runs at a saturating chunk size instead of the caller's
+        # per-step batch; FID's states are order-independent per-image sums,
+        # so buffering per flag preserves semantics exactly, and any state
+        # read flushes first
+        self._push_or_ingest(bool(real), imgs)
+
+    def _ingest_chunk(self, key: bool, imgs: Array) -> None:
+        self._ingest(imgs, key)
 
     def _ingest(self, imgs: Array, real: bool) -> None:
         features = jnp.asarray(self.extractor(imgs))
@@ -155,39 +153,6 @@ class FrechetInceptionDistance(Metric):
             self.fake_sum = self.fake_sum + features.sum(axis=0)
             self.fake_outer = self.fake_outer + features.T @ features
             self.fake_n = self.fake_n + features.shape[0]
-
-    def _drain_buffer(self, real: bool, keep_partial: bool) -> None:
-        """Run the extractor over buffered images in ``extractor_batch``
-        chunks.  One concatenation per drain (not per chunk), then chunk
-        slices off the joined array; with ``keep_partial`` the sub-chunk tail
-        stays buffered for the next update."""
-        buf = self._img_buffer.get(bool(real), [])
-        total = sum(b.shape[0] for b in buf)
-        chunk = self.extractor_batch or max(total, 1)
-        if total == 0 or (keep_partial and total < chunk):
-            return
-        cat = buf[0] if len(buf) == 1 else np.concatenate(buf, axis=0)
-        # guard: _ingest's state reads re-enter __getattr__, which flushes
-        # dirty host buffers — already doing exactly that here
-        self._flushing_images = True
-        try:
-            off = 0
-            while total - off >= chunk:
-                self._ingest(cat[off : off + chunk], real)
-                off += chunk
-            if not keep_partial and off < total:
-                self._ingest(cat[off:], real)
-                off = total
-        finally:
-            self._flushing_images = False
-        self._img_buffer[bool(real)] = [cat[off:]] if off < total else []
-        self._host_buffers_dirty = any(self._img_buffer.get(f) for f in (True, False))
-
-    def _flush_host_buffers(self) -> None:
-        if getattr(self, "_flushing_images", False) or not getattr(self, "extractor_batch", None):
-            return
-        for flag in (True, False):
-            self._drain_buffer(flag, keep_partial=False)
 
     @staticmethod
     def _mean_cov(total: Array, outer: Array, n: Array):
@@ -202,8 +167,17 @@ class FrechetInceptionDistance(Metric):
         return _compute_fid(mu1, sigma1, mu2, sigma2)
 
     def reset(self) -> None:
-        self._img_buffer = {True: [], False: []}
-        self._host_buffers_dirty = False
+        if not self.reset_real_features and getattr(self, "_queue", None) is not None:
+            # buffered REAL images belong to the preserved statistics — fold
+            # them in before the queue is cleared (fake images are part of
+            # the discarded epoch and are dropped with it)
+            self._flushing_images = True
+            try:
+                for chunk in self._queue.drain(True):
+                    self._ingest_chunk(True, chunk)
+            finally:
+                self._flushing_images = False
+        self._reset_chunking()
         if not self.reset_real_features:
             saved = {k: self._state[k] for k in ("real_sum", "real_outer", "real_n")}
             super().reset()

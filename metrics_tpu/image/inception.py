@@ -5,6 +5,7 @@ from typing import Any, Callable, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.image._batching import ChunkedExtractorMixin
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -12,11 +13,16 @@ from metrics_tpu.utils.prints import rank_zero_warn
 Array = jax.Array
 
 
-class InceptionScore(Metric):
+class InceptionScore(ChunkedExtractorMixin, Metric):
     """IS = exp(E_x KL(p(y|x) || p(y))), over `splits` chunks.
 
     Per-sample class logits must be kept (the marginal p(y) depends on the
     final split), so this is a genuine list-state metric.
+
+    Args (extraction):
+        extractor_batch: buffer incoming images host-side and run the
+            extractor at this saturating chunk size (exact — feature rows
+            are per-image; ``None`` runs it at the caller's batch size).
     """
 
     higher_is_better = True
@@ -29,9 +35,11 @@ class InceptionScore(Metric):
         feature: Union[str, int, Callable] = "logits_unbiased",
         splits: int = 10,
         inception_params: Optional[dict] = None,
+        extractor_batch: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self._init_chunking(extractor_batch)
         if isinstance(feature, (int, str)):
             from metrics_tpu.image.backbones.inception import VALID_FEATURE_DIMS
             from metrics_tpu.image.backbones.weights import make_inception_extractor
@@ -55,7 +63,17 @@ class InceptionScore(Metric):
         self.add_state("features", default=[], dist_reduce_fx="cat")
 
     def update(self, imgs: Array) -> None:
+        # extractor_batch buffers images host-side so the extractor runs at
+        # a saturating chunk size; feature rows are per-image, so chunk
+        # boundaries cannot change any result
+        self._push_or_ingest(None, imgs)
+
+    def _ingest_chunk(self, key: Any, imgs: Array) -> None:
         self.features.append(jnp.asarray(self.extractor(imgs)))
+
+    def reset(self) -> None:
+        self._reset_chunking()
+        super().reset()
 
     def compute(self) -> Tuple[Array, Array]:
         features = dim_zero_cat(self.features)

@@ -5,6 +5,7 @@ from typing import Any, Callable, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.image._batching import ChunkedExtractorMixin
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -39,11 +40,16 @@ def poly_mmd(
     return maximum_mean_discrepancy(k_11, k_12, k_22)
 
 
-class KernelInceptionDistance(Metric):
+class KernelInceptionDistance(ChunkedExtractorMixin, Metric):
     """KID: polynomial-kernel MMD over feature subsets (mean, std).
 
     The subset resampling is vmapped over one batched random-index tensor —
     ``subsets`` MMD estimates run as a single XLA program.
+
+    Args (extraction):
+        extractor_batch: buffer incoming images host-side and run the
+            extractor at this saturating chunk size (exact — feature rows
+            are per-image; ``None`` runs it at the caller's batch size).
     """
 
     higher_is_better = False
@@ -62,9 +68,11 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         inception_params: Optional[dict] = None,
         seed: int = 17,
+        extractor_batch: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self._init_chunking(extractor_batch)
         if isinstance(feature, int):
             from metrics_tpu.image.backbones.inception import VALID_FEATURE_DIMS
             from metrics_tpu.image.backbones.weights import make_inception_extractor
@@ -108,8 +116,14 @@ class KernelInceptionDistance(Metric):
         self.add_state("fake_features", default=[], dist_reduce_fx="cat")
 
     def update(self, imgs: Array, real: bool) -> None:
+        # extractor_batch buffers images host-side so the extractor runs at
+        # a saturating chunk size; feature rows are per-image, so chunk
+        # boundaries cannot change any result
+        self._push_or_ingest(bool(real), imgs)
+
+    def _ingest_chunk(self, key: bool, imgs: Array) -> None:
         features = jnp.asarray(self.extractor(imgs))
-        if real:
+        if key:
             self.real_features.append(features)
         else:
             self.fake_features.append(features)
@@ -141,6 +155,16 @@ class KernelInceptionDistance(Metric):
         return kid_scores.mean(), kid_scores.std(ddof=0)
 
     def reset(self) -> None:
+        if not self.reset_real_features and getattr(self, "_queue", None) is not None:
+            # buffered REAL images belong to the preserved features — fold
+            # them in before the queue is cleared
+            self._flushing_images = True
+            try:
+                for chunk in self._queue.drain(True):
+                    self._ingest_chunk(True, chunk)
+            finally:
+                self._flushing_images = False
+        self._reset_chunking()
         if not self.reset_real_features:
             saved = self._state["real_features"]
             super().reset()

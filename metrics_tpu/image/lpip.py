@@ -14,6 +14,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.image._batching import ChunkedExtractorMixin
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -82,7 +83,7 @@ class _LpipsBackbone(nn.Module):
         return total
 
 
-class LearnedPerceptualImagePatchSimilarity(Metric):
+class LearnedPerceptualImagePatchSimilarity(ChunkedExtractorMixin, Metric):
     """Streaming LPIPS with scalar sum/total states (reference ``lpip.py:118-119``).
 
     Args:
@@ -90,6 +91,11 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
             pass ``net`` (callable ``(img1, img2) -> (N,)``) directly.
         reduction: ``'mean'`` or ``'sum'`` over the accumulated scores.
         normalize: if True inputs are in ``[0, 1]`` and shifted to ``[-1, 1]``.
+    
+    Args (extraction):
+        extractor_batch: buffer incoming image pairs host-side and run the
+            backbone at this saturating chunk size (exact — scores are
+            per-pair sums; ``None`` runs it at the caller's batch size).
     """
 
     is_differentiable = True
@@ -104,9 +110,11 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         normalize: bool = False,
         net: Optional[Callable] = None,
         lpips_params: Optional[dict] = None,
+        extractor_batch: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self._init_chunking(extractor_batch)
         valid_net_type = ("vgg", "alex", "squeeze")
         if net is None:
             if net_type not in valid_net_type:
@@ -167,9 +175,25 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         return img
 
     def update(self, img1: Array, img2: Array) -> None:
-        scores = self._net(self._prepare(img1), self._prepare(img2))
+        a, b = self._prepare(img1), self._prepare(img2)
+        if self._queue is None:
+            self._score(a, b)
+            return
+        # pairs are stacked along a new axis so both sides chunk in lockstep
+        self._push_or_ingest(None, jnp.stack([a, b], axis=1))
+
+    def _ingest_chunk(self, key: Any, pairs: Array) -> None:
+        pairs = jnp.asarray(pairs)
+        self._score(pairs[:, 0], pairs[:, 1])
+
+    def _score(self, a: Array, b: Array) -> None:
+        scores = self._net(a, b)
         self.sum_scores = self.sum_scores + jnp.sum(scores)
         self.total = self.total + scores.shape[0]
+
+    def reset(self) -> None:
+        self._reset_chunking()
+        super().reset()
 
     def compute(self) -> Array:
         if self.reduction == "mean":

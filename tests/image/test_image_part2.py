@@ -284,8 +284,78 @@ class TestFIDExtractorBatching:
         m = FrechetInceptionDistance(feature=_flat8_extractor, feature_dim=8, extractor_batch=64)
         m.update(rng.random((4, 2, 2, 2), dtype=np.float32), real=True)
         assert float(m.real_n) == 4.0  # attribute read flushed the buffer
-        assert not m._img_buffer[True]
+        assert not m._queue.pending
         m.update(rng.random((4, 2, 2, 2), dtype=np.float32), real=True)
         m.reset()
-        assert not m._img_buffer[True]  # reset drops buffered images
+        assert not m._queue.pending  # reset drops buffered images
+        assert not m._host_buffers_dirty
         assert float(m.real_n) == 0.0
+
+    def test_reset_preserving_real_features_drains_buffered_reals(self):
+        """reset_real_features=False must fold BUFFERED real images into the
+        preserved statistics before clearing the queue (observation-order
+        independence)."""
+        from metrics_tpu import FrechetInceptionDistance
+
+        rng = np.random.default_rng(53)
+        a = rng.random((12, 2, 2, 2), dtype=np.float32)
+        m = FrechetInceptionDistance(
+            feature=_flat8_extractor, feature_dim=8, extractor_batch=64,
+            reset_real_features=False,
+        )
+        m.update(a, real=True)  # 12 images, all still queued (< 64)
+        m.reset()
+        assert float(m.real_n) == 12.0  # preserved INCLUDING the queued ones
+
+    def test_empty_batch_does_not_wedge_queue(self):
+        from metrics_tpu import FrechetInceptionDistance
+
+        m = FrechetInceptionDistance(feature=_flat8_extractor, feature_dim=8, extractor_batch=8)
+        m.update(np.empty((0, 2, 2, 2), np.float32), real=True)
+        assert not m._queue.pending
+        m.update(np.ones((8, 2, 2, 2), np.float32), real=True)
+        assert float(m.real_n) == 8.0
+
+    def test_is_kid_lpips_buffered_match_unbuffered(self):
+        from metrics_tpu import (
+            InceptionScore,
+            KernelInceptionDistance,
+            LearnedPerceptualImagePatchSimilarity,
+        )
+
+        rng = np.random.default_rng(52)
+        a = rng.random((30, 2, 3, 2), dtype=np.float32)
+        b = rng.random((30, 2, 3, 2), dtype=np.float32)
+
+        def feat(x):
+            import jax.numpy as jnp
+
+            return jnp.asarray(x, jnp.float32).reshape(x.shape[0], -1)[:, :12] * 1.0
+
+        m1 = InceptionScore(feature=feat, splits=3)
+        m2 = InceptionScore(feature=feat, splits=3, extractor_batch=8)
+        k1 = KernelInceptionDistance(feature=feat, subsets=4, subset_size=10)
+        k2 = KernelInceptionDistance(feature=feat, subsets=4, subset_size=10, extractor_batch=8)
+
+        def net(x, y):
+            import jax.numpy as jnp
+
+            return jnp.mean((x - y) ** 2, axis=(1, 2, 3))
+
+        l1 = LearnedPerceptualImagePatchSimilarity(net=net)
+        l2 = LearnedPerceptualImagePatchSimilarity(net=net, extractor_batch=8)
+        for i in range(0, 30, 5):
+            m1.update(a[i : i + 5])
+            m2.update(a[i : i + 5])
+            for k in (k1, k2):
+                k.update(a[i : i + 5], real=True)
+                k.update(b[i : i + 5], real=False)
+            l1.update(a[i : i + 5].repeat(2, axis=2), b[i : i + 5].repeat(2, axis=2))
+            l2.update(a[i : i + 5].repeat(2, axis=2), b[i : i + 5].repeat(2, axis=2))
+        np.testing.assert_allclose(
+            [float(x) for x in m2.compute()], [float(x) for x in m1.compute()], atol=1e-5
+        )
+        np.testing.assert_allclose(
+            [float(x) for x in k2.compute()], [float(x) for x in k1.compute()], atol=1e-5
+        )
+        np.testing.assert_allclose(float(l2.compute()), float(l1.compute()), atol=1e-6)
