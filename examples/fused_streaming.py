@@ -1,11 +1,15 @@
-"""Example: the three single-dispatch streaming shapes.
+"""Example: the four single-dispatch streaming shapes.
 
-1. ``metric(batch)`` — forward: batch value + accumulation, fused into one
+1. ``metric.update(batch)`` — the reference-shaped eager loop; updates
+   accumulate by default (``lazy_updates=64``) and flush through one
+   ``lax.scan`` dispatch, so the loop does not pay one device dispatch per
+   step.  Results are identical to immediate updates.
+2. ``metric(batch)`` — forward: batch value + accumulation, fused into one
    compiled program with donated state buffers.
-2. ``metric.update_batched(stack)`` — a whole stacked stream folded through
-   one ``lax.scan`` program.
-3. ``BootStrapper(..., "multinomial")`` — every bootstrap replica in one
-   vmapped program.
+3. ``metric.update_batched(stack)`` — a whole stacked stream folded through
+   one ``lax.scan`` program explicitly.
+4. ``BootStrapper`` — every bootstrap replica in one vmapped program, for
+   BOTH poisson (default) and multinomial resampling.
 
 Run anywhere: ``JAX_PLATFORMS=cpu python examples/fused_streaming.py``
 """
@@ -24,19 +28,29 @@ def main() -> None:
     preds = jnp.asarray(rng.random((n_batches, batch, classes), dtype=np.float32))
     target = jnp.asarray(rng.integers(0, classes, size=(n_batches, batch)))
 
-    # 1. training-loop shape: per-step batch value, one dispatch per step
+    # 1. migrated-user shape: plain update() per step — lazily accumulated
+    #    and flushed as one scan dispatch per 64 batches (the default)
+    lazy = Accuracy(num_classes=classes, validate_args=False)
+    for i in range(n_batches):
+        lazy.update(preds[i], target[i])
+    print(f"lazy loop epoch acc {float(lazy.compute()):.4f}  (dispatches ~ n/64)")
+
+    # 2. training-loop shape: per-step batch value, one dispatch per step
     metric = Accuracy(num_classes=classes, validate_args=False)
     for i in range(n_batches):
         batch_acc = metric(preds[i], target[i])
     print(f"last-batch acc {float(batch_acc):.4f}  epoch acc {float(metric.compute()):.4f}")
 
-    # 2. stacked-stream shape: the whole epoch in ONE dispatch
+    # 3. stacked-stream shape: the whole epoch in ONE explicit dispatch
     fused = Accuracy(num_classes=classes, validate_args=False)
     fused.update_batched(preds, target)
     assert np.isclose(float(fused.compute()), float(metric.compute()))
+    assert np.isclose(float(fused.compute()), float(lazy.compute()))
     print(f"fused epoch acc  {float(fused.compute()):.4f}  (update_batched == loop)")
 
-    # 3. bootstrap confidence band: all replicas in one vmapped program
+    # 4. bootstrap confidence band: all replicas in one vmapped program
+    #    (poisson — the default — uses fixed-capacity resamples; multinomial
+    #    shown here)
     boot = BootStrapper(
         Accuracy(num_classes=classes, validate_args=False),
         num_bootstraps=50,
