@@ -178,6 +178,7 @@ class Metric(ABC):
         # batches) at the threshold or at any state read
         self._pending: List[Tuple[tuple, dict]] = []
         self._pending_sig: Any = None
+        self._jitted_flush: Optional[Dict[Any, Callable]] = None
         self._jitted_stack: Optional[Callable] = None
 
         self._update_count = 0
@@ -708,52 +709,125 @@ class Metric(ABC):
             self._flush_pending()
         return True
 
+    # partial flushes at or above this size use the one-dispatch scan path
+    # (one extra scan compile per distinct epoch-tail length); below it the
+    # direct per-update path is cheaper than a fresh compile
+    _LAZY_SCAN_MIN = 8
+
     def _flush_pending(self) -> None:
         """Fold every pending lazy update into state.
 
-        A FULL accumulator (threshold reached) flushes as ONE ``lax.scan``
-        dispatch — the stack shape is always ``lazy_updates``, so the scan
-        program compiles once per input signature.  Partial flushes (forced
-        by a state read, a signature change, or ``compute`` at epoch end)
-        run the direct per-update path instead: they happen rarely, and
-        compiling a fresh scan for every distinct partial length would cost
-        far more than the handful of dispatches it saves.
+        Flushes of :attr:`lazy_updates` items (and partial flushes of at
+        least ``_LAZY_SCAN_MIN``) run as ONE compiled dispatch: the pending
+        columns are stacked INSIDE the program that scans them, so a flush
+        costs a single executable launch.  Tiny partial flushes run the
+        direct per-update path — compiling a scan for every small tail
+        length would cost far more than the dispatches it saves.
         """
         pending = self.__dict__.get("_pending")
         if not pending:
             return
         self._pending = []
         self._pending_sig = None
-        if len(pending) < self.lazy_updates:
+        if len(pending) < min(self._LAZY_SCAN_MIN, self.lazy_updates or self._LAZY_SCAN_MIN):
+            # small windows still get their one-dispatch threshold flush
             for args, kwargs in pending:
                 self._update_now(*args, **kwargs)
             return
         leaves_list = [jax.tree_util.tree_flatten((a, k))[0] for a, k in pending]
         treedef = jax.tree_util.tree_flatten(pending[0])[1]
-        stacked: List[Any] = []
-        device_cols = []  # (position, values) stacked in ONE compiled program
-        for vals in zip(*leaves_list):
-            v0 = vals[0]
-            if hasattr(v0, "ndim") and hasattr(v0, "shape"):
-                if all(isinstance(v, np.ndarray) for v in vals):
-                    stacked.append(np.stack(vals))  # one host->device transfer
-                else:
-                    device_cols.append((len(stacked), vals))
-                    stacked.append(None)
+        cols = list(zip(*leaves_list))
+        # per-leaf column kind: host numpy columns stack ON HOST (one
+        # transfer); device columns stack INSIDE the flush program (one
+        # dispatch, no per-element eager ops); the rest pass through static
+        kinds = []
+        for vals, v0 in zip(cols, leaves_list[0]):
+            if not (hasattr(v0, "ndim") and hasattr(v0, "shape")):
+                kinds.append("static")
+            elif all(isinstance(v, np.ndarray) for v in vals):
+                kinds.append("np")
             else:
-                stacked.append(v0)  # identical across pending (signature)
-        if device_cols:
-            # eager jnp.stack dispatches one expand op PER ELEMENT; a jitted
-            # stack is a single dispatch for every column at once
-            if self._jitted_stack is None:
-                self._jitted_stack = jax.jit(
-                    lambda cols: tuple(jnp.stack(c) for c in cols)
-                )
-            outs = self._jitted_stack(tuple(vals for _, vals in device_cols))
-            for (pos, _), out in zip(device_cols, outs):
-                stacked[pos] = out
+                kinds.append("dev")
+        if not self._buffer_states and self._flush_via_scan(pending, cols, treedef, kinds):
+            return
+        # fallback (buffer-state metrics, untraceable bodies): stack every
+        # column, then fold through update_batched's eager-capable path
+        stacked: List[Any] = []
+        for vals, kind in zip(cols, kinds):
+            if kind == "np":
+                stacked.append(np.stack(vals))  # one host->device transfer
+            elif kind == "dev":
+                # a jitted stack is ONE dispatch; eager jnp.stack dispatches
+                # one expand op per element
+                if self._jitted_stack is None:
+                    self._jitted_stack = jax.jit(lambda c: jnp.stack(c))
+                stacked.append(self._jitted_stack(tuple(vals)))
+            else:
+                stacked.append(vals[0])  # identical across pending (signature)
         s_args, s_kwargs = jax.tree_util.tree_unflatten(treedef, stacked)
         self.update_batched(*s_args, **s_kwargs)
+
+    def _flush_via_scan(self, pending, cols, treedef, kinds) -> bool:
+        """ONE executable launch per flush: device-column stacking + the
+        whole scan fused into a single jit program (host numpy columns are
+        stacked host-side first — one transfer each).
+
+        Returns False (nothing executed) when the update body cannot trace;
+        the caller falls back to the stacked ``update_batched`` path, which
+        owns the eager fallbacks.
+        """
+        statics = tuple(
+            vals[0] if kind == "static" else None for vals, kind in zip(cols, kinds)
+        )
+        try:
+            key = (treedef, statics, tuple(kinds), len(pending))
+            hash(key)
+        except TypeError:
+            return False
+        if self._jitted_flush is None:
+            self._jitted_flush = {}
+        prog = self._jitted_flush.get(key)
+        if prog is None:
+            def flush_prog(state: Dict[str, Any], np_stacks: tuple, dev_cols: tuple) -> Dict[str, Any]:
+                np_it, dev_it = iter(np_stacks), iter(dev_cols)
+                arr_stack = tuple(
+                    next(np_it) if kind == "np" else jnp.stack(next(dev_it))
+                    for kind in kinds
+                    if kind != "static"
+                )
+
+                def body(st: Dict[str, Any], sl: tuple):
+                    sit = iter(sl)
+                    leaves = [next(sit) if kind != "static" else s for kind, s in zip(kinds, statics)]
+                    a, kw = jax.tree_util.tree_unflatten(treedef, leaves)
+                    _, new = self._run_with_state(st, self._update_impl, a, kw)
+                    return new, None
+
+                new_state, _ = jax.lax.scan(body, state, arr_stack)
+                return new_state
+
+            donate = (0,) if self.donate_state else ()
+            prog = jax.jit(flush_prog, donate_argnums=donate)
+            self._jitted_flush[key] = prog
+        np_stacks = tuple(np.stack(vals) for vals, kind in zip(cols, kinds) if kind == "np")
+        dev_cols = tuple(tuple(vals) for vals, kind in zip(cols, kinds) if kind == "dev")
+        try:
+            with _quiet_donation():
+                new_state = prog(self._state, np_stacks, dev_cols)
+        except (
+            TypeError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerIntegerConversionError,
+            jax.errors.NonConcreteBooleanIndexError,
+        ):
+            # trace-time failure: nothing executed (donated buffers intact)
+            self._jitted_flush.pop(key, None)
+            return False
+        self._state.update(new_state)
+        self._computed = None
+        self._update_count += len(pending)
+        return True
 
     def _update_wrapper(self, *args: Any, **kwargs: Any) -> None:
         if self._is_synced:
@@ -1299,6 +1373,8 @@ class Metric(ABC):
         self._jitted_update_batched = None
         self._jitted_compute = None
         self._jitted_forward = None
+        self._jitted_flush = None
+        self._jitted_stack = None
         return self
 
     def float(self) -> "Metric":
@@ -1379,6 +1455,7 @@ class Metric(ABC):
         d["_jitted_update_batched"] = None
         d["_jitted_compute"] = None
         d["_jitted_forward"] = None
+        d["_jitted_flush"] = None
         d["_jitted_stack"] = None
         d["_state"] = {
             k: (
