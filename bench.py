@@ -281,7 +281,7 @@ _WORDS = (
 ).split()
 
 
-def _bench_text(n_batches=4, sentences_per_batch=32):
+def _bench_text(n_batches=16, sentences_per_batch=32):
     """Config 4: BERTScore (12-layer BERT-base Flax encoder) + ROUGE.
 
     Tokenization runs the first-party WordPiece implementation (real greedy
