@@ -35,18 +35,7 @@ import warnings
 
 import numpy as np
 
-_N_BATCH_SMALL, _N_BATCH_LARGE, _BATCH, _CLASSES = 16, 128, 8192, 10
-
-
-def _make_accuracy_data(n_batches):
-    import jax.numpy as jnp
-
-    rng = np.random.default_rng(0)
-    preds = jnp.asarray(rng.random((n_batches, _BATCH, _CLASSES), dtype=np.float32))
-    preds = preds / preds.sum(-1, keepdims=True)
-    target = jnp.asarray(rng.integers(0, _CLASSES, size=(n_batches, _BATCH)))
-    return preds, target
-
+_BATCH, _CLASSES = 8192, 10
 
 _REPEATS = 5
 
