@@ -545,19 +545,25 @@ class MeanAveragePrecision(Metric):
         t0 = _time.perf_counter()
 
         # ---- precision/recall tables
+        # the score-sorted column set per (class, max_det) is area-independent:
+        # sort once, reuse across all four area ranges
+        cols_sorted: Dict[Tuple[int, int], np.ndarray] = {}
+        for k_idx, cls in enumerate(classes):
+            dc0, dc1 = np.searchsorted(dl, cls, "left"), np.searchsorted(dl, cls, "right")
+            for m_idx, max_det in enumerate(self.max_detection_thresholds):
+                cols = np.flatnonzero(d_pos[dc0:dc1] < max_det) + dc0
+                if cols.size:
+                    cols = cols[np.argsort(-ds[cols], kind="mergesort")]
+                cols_sorted[(k_idx, m_idx)] = cols
         for a_idx, (a_lo, a_hi) in enumerate(area_ranges):
             codes = codes_by_area[a_idx]
             d_out = (d_area_s < a_lo) | (d_area_s > a_hi)
             for k_idx, cls in enumerate(classes):
-                dc0, dc1 = np.searchsorted(dl, cls, "left"), np.searchsorted(dl, cls, "right")
                 for m_idx, max_det in enumerate(self.max_detection_thresholds):
                     if npig[k_idx, a_idx] == 0:
                         continue
-                    keep = d_pos[dc0:dc1] < max_det
-                    cols = np.flatnonzero(keep) + dc0
+                    cols = cols_sorted[(k_idx, m_idx)]
                     if cols.size:
-                        order = np.argsort(-ds[cols], kind="mergesort")
-                        cols = cols[order]
                         c = codes[:, cols]
                         d_o = d_out[cols]
                         tps = np.cumsum(c == 1, axis=1, dtype=np.float64)
