@@ -550,11 +550,16 @@ class MeanAveragePrecision(Metric):
         cols_sorted: Dict[Tuple[int, int], np.ndarray] = {}
         for k_idx, cls in enumerate(classes):
             dc0, dc1 = np.searchsorted(dl, cls, "left"), np.searchsorted(dl, cls, "right")
+            # one sort at the largest (already-capped) threshold; smaller
+            # thresholds filter the sorted array, which preserves the stable
+            # score order
+            cols_max = np.arange(dc0, dc1)
+            if cols_max.size:
+                cols_max = cols_max[np.argsort(-ds[cols_max], kind="mergesort")]
             for m_idx, max_det in enumerate(self.max_detection_thresholds):
-                cols = np.flatnonzero(d_pos[dc0:dc1] < max_det) + dc0
-                if cols.size:
-                    cols = cols[np.argsort(-ds[cols], kind="mergesort")]
-                cols_sorted[(k_idx, m_idx)] = cols
+                cols_sorted[(k_idx, m_idx)] = (
+                    cols_max[d_pos[cols_max] < max_det] if cols_max.size else cols_max
+                )
         for a_idx, (a_lo, a_hi) in enumerate(area_ranges):
             codes = codes_by_area[a_idx]
             d_out = (d_area_s < a_lo) | (d_area_s > a_hi)

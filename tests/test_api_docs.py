@@ -1,0 +1,34 @@
+"""The generated API reference must cover every exported module class.
+
+Pages are rendered by ``python -m tools.gen_api_docs``; this test fails when
+a newly added metric has no page entry (regenerate) or a page references a
+class that no longer exists (stale docs)."""
+
+import os
+import re
+
+import pytest
+
+from tools.gen_api_docs import DOMAINS, OUT_DIR, _public_classes
+
+
+@pytest.mark.parametrize("mod_name,title", DOMAINS)
+def test_api_page_covers_every_class(mod_name, title):
+    import importlib
+
+    path = os.path.join(OUT_DIR, f"{mod_name}.md")
+    assert os.path.exists(path), f"missing {path}; run `python -m tools.gen_api_docs`"
+    text = open(path).read()
+    documented = set(re.findall(r"^### `(\w+)`", text, re.M))
+    module = importlib.import_module(f"metrics_tpu.{mod_name}")
+    exported = {name for name, _ in _public_classes(module)}
+    missing = exported - documented
+    assert not missing, f"{mod_name}: undocumented classes {sorted(missing)}; regenerate"
+    stale = documented - exported
+    assert not stale, f"{mod_name}: stale page entries {sorted(stale)}; regenerate"
+
+
+def test_api_index_links_every_domain():
+    text = open(os.path.join(OUT_DIR, "README.md")).read()
+    for mod_name, _ in DOMAINS:
+        assert f"({mod_name}.md)" in text
