@@ -590,8 +590,15 @@ def _bench_map_segm_scale(n_img=500, canvas=(480, 640)):
     metric = MeanAveragePrecision(iou_type="segm")
     start = time.perf_counter()
     metric.update(preds, targets)
-    metric.compute()
-    return n_img / (time.perf_counter() - start)
+    t_update = time.perf_counter() - start
+    start = time.perf_counter()
+    out = metric.compute()
+    t_compute = time.perf_counter() - start
+    prof = {k: round(v, 4) for k, v in getattr(metric, "last_compute_profile", {}).items()}
+    prof["update"] = round(t_update, 4)
+    prof["compute_total"] = round(t_compute, 4)
+    prof["map"] = round(float(out["map"]), 4)
+    return n_img / (t_update + t_compute), prof
 
 
 def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
@@ -670,6 +677,9 @@ def main() -> None:
             elif name.startswith("config5_map_coco_scale"):
                 extra[name] = round(result[0], 1)
                 extra["config5_map_coco_scale_profile"] = result[1]
+            elif name.startswith("config5_map_segm_scale"):
+                extra[name] = round(result[0], 1)
+                extra["config5_map_segm_scale_profile"] = result[1]
             elif name.startswith("config4"):
                 extra[name] = round(result[0], 1)
                 extra["config4_tokenizer_split"] = result[1]

@@ -22,6 +22,7 @@ _SRC = os.path.join(_HERE, "native.cpp")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_SCRATCH = threading.local()
 
 
 def _build_lib() -> Optional[ctypes.CDLL]:
@@ -63,6 +64,11 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint32),
         ]
+        lib.mtpu_rle_encode_batch.restype = ctypes.c_int64
+        lib.mtpu_rle_encode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.mtpu_rle_decode.restype = None
         lib.mtpu_rle_decode.argtypes = [
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
@@ -70,6 +76,11 @@ def _build_lib() -> Optional[ctypes.CDLL]:
         ]
         lib.mtpu_rle_area.restype = ctypes.c_int64
         lib.mtpu_rle_area.argtypes = [ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64]
+        lib.mtpu_rle_area_batch.restype = None
+        lib.mtpu_rle_area_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
+        ]
         lib.mtpu_rle_intersection.restype = ctypes.c_int64
         lib.mtpu_rle_intersection.argtypes = [
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
@@ -100,6 +111,15 @@ def _build_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.mtpu_coco_tables.restype = None
+        lib.mtpu_coco_tables.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
         ]
         lib.mtpu_coco_match_blocks.restype = None
         lib.mtpu_coco_match_blocks.argtypes = [
@@ -339,9 +359,87 @@ def coco_match_blocks(
     return codes
 
 
+def coco_tables(
+    codes: np.ndarray, cols: np.ndarray, dout: np.ndarray,
+    seg_starts: np.ndarray, seg_sizes: np.ndarray,
+    npig: np.ndarray, rec_thrs: np.ndarray,
+):
+    """Per-class-segment precision/recall tables in one native call.
+
+    Args: codes (T, N_full) uint8 raw match-code table; cols — column ids
+    selecting and ordering the evaluated detections by (class, score desc);
+    dout (N_full,) bool out-of-area flags (original column order);
+    seg_starts/seg_sizes (S,) per-class segments as positions into ``cols``;
+    npig (S,) counted gts per segment; rec_thrs (R,) ascending recall
+    thresholds.  Returns (precision (T, R, S), recall (T, S)) with segments
+    of ``npig <= 0`` zero-filled, or None if no native lib.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes, dtype=np.uint8)
+    cols = _i64(cols)
+    dout = np.ascontiguousarray(dout, dtype=np.uint8)
+    seg_starts, seg_sizes = _i64(seg_starts), _i64(seg_sizes)
+    npig = np.ascontiguousarray(npig, dtype=np.float64)
+    rec_thrs = np.ascontiguousarray(rec_thrs, dtype=np.float64)
+    T, N = codes.shape
+    S, R = len(seg_starts), len(rec_thrs)
+    prec = np.zeros((T, R, S), dtype=np.float64)
+    rec = np.zeros((T, S), dtype=np.float64)
+    lib.mtpu_coco_tables(
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), N,
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        dout.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        seg_starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        seg_sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        npig.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        rec_thrs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        T, S, R,
+        prec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        rec.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return prec, rec
+
+
 # ---------------------------------------------------------------------------
 # RLE masks (COCO column-major convention)
 # ---------------------------------------------------------------------------
+def rle_encode_batch(masks: np.ndarray):
+    """Encode a stacked (N, H, W) mask tensor in one native call.
+
+    Returns (runs, runcounts): all masks' uncompressed column-major RLE run
+    arrays concatenated, plus per-mask run counts — exactly the segm state
+    layout of ``MeanAveragePrecision``.  Falls back to per-mask encodes
+    without the native lib.
+    """
+    masks = np.ascontiguousarray(masks, dtype=np.uint8)
+    if masks.ndim != 3:
+        raise ValueError(f"rle_encode_batch expects (N, H, W), got {masks.shape}")
+    n, h, w = masks.shape
+    lib = get_lib()
+    if lib is None or n == 0:
+        rles = [rle_encode(m) for m in masks]
+        runs = np.concatenate(rles) if rles else np.zeros(0, np.uint32)
+        return runs, np.asarray([len(r) for r in rles], np.int64)
+    # worst-case capacity is n*(h*w+1); reuse a growing thread-local scratch
+    # so a streaming update loop is not one large allocation per call
+    need = n * (h * w + 1)
+    runs = getattr(_SCRATCH, "runs", None)
+    if runs is None or runs.size < need:
+        runs = np.empty(max(need, 1 << 20), dtype=np.uint32)
+        _SCRATCH.runs = runs
+    runcounts = np.empty(n, dtype=np.int64)
+    total = lib.mtpu_rle_encode_batch(
+        masks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w,
+        runs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        runcounts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return runs[:total].copy(), runcounts
+
+
+
 def rle_encode(mask: np.ndarray) -> np.ndarray:
     """Binary HxW mask -> uncompressed RLE counts (column-major, 0-run first)."""
     mask = np.ascontiguousarray(np.asfortranarray(mask.astype(np.uint8)).ravel(order="F"))
@@ -398,6 +496,23 @@ def rle_area(counts: np.ndarray) -> int:
     if lib is not None:
         return int(lib.mtpu_rle_area(counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), len(counts)))
     return int(counts[1::2].sum())
+
+
+def rle_area_batch(runs: np.ndarray, runcounts: np.ndarray):
+    """Per-mask areas over concatenated run arrays; None if no native lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    runs = np.ascontiguousarray(runs, dtype=np.uint32)
+    runcounts = _i64(runcounts)
+    out = np.empty(len(runcounts), dtype=np.float64)
+    lib.mtpu_rle_area_batch(
+        runs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        runcounts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(runcounts),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out
 
 
 def rle_iou(a: np.ndarray, b: np.ndarray, iscrowd_b: bool = False) -> float:

@@ -67,6 +67,78 @@ int64_t mtpu_rle_encode(const uint8_t* mask, int64_t h, int64_t w, uint32_t* cou
     return n_runs;
 }
 
+// Batched RLE encode: n C-contiguous (h, w) masks in one call.  Each mask is
+// scanned in column-major (Fortran) order via stride arithmetic — no host-side
+// transpose copy.  Runs for all masks are written back to back into
+// `runs` (capacity n*(h*w+1)); per-mask run counts go to `runcounts`.
+// Returns the total number of runs written.
+int64_t mtpu_rle_encode_batch(const uint8_t* masks, int64_t n, int64_t h, int64_t w,
+                              uint32_t* runs, int64_t* runcounts) {
+    // A column-major scan of a row-major mask is cache-hostile (one byte per
+    // cache line).  Instead: consecutive column-major elements are vertical
+    // neighbours, so value changes are exactly the row[i] != row[i+1]
+    // positions — detected row-major (sequential loads, 8-byte XOR fast
+    // skip over equal spans), plus the column-seam comparisons
+    // (h-1, j) -> (0, j+1).  Boundary positions are then sorted (masks have
+    // few boundaries) and differenced into runs.
+    int64_t total = 0;
+    std::vector<int64_t> bnd;
+    for (int64_t m = 0; m < n; ++m) {
+        const uint8_t* M = masks + m * h * w;
+        uint32_t* out = runs + total;
+        int64_t n_runs = 0;
+        if (h * w == 0) {
+            out[n_runs++] = 0;
+            runcounts[m] = n_runs;
+            total += n_runs;
+            continue;
+        }
+        bnd.clear();
+        for (int64_t i = 0; i + 1 < h; ++i) {
+            const uint8_t* r0 = M + i * w;
+            const uint8_t* r1 = r0 + w;
+            int64_t j = 0;
+            // 64-byte fast path: one branch per cache line of equal bytes
+            for (; j + 64 <= w; j += 64) {
+                uint64_t acc = 0;
+                for (int64_t c = 0; c < 64; c += 8) {
+                    uint64_t a, b;
+                    std::memcpy(&a, r0 + j + c, 8);
+                    std::memcpy(&b, r1 + j + c, 8);
+                    acc |= a ^ b;
+                }
+                if (acc == 0) continue;
+                for (int64_t k = j; k < j + 64; ++k)
+                    if ((r0[k] != 0) != (r1[k] != 0)) bnd.push_back(k * h + i + 1);
+            }
+            for (; j + 8 <= w; j += 8) {
+                uint64_t a, b;
+                std::memcpy(&a, r0 + j, 8);
+                std::memcpy(&b, r1 + j, 8);
+                if (a == b) continue;
+                for (int64_t k = j; k < j + 8; ++k)
+                    if ((r0[k] != 0) != (r1[k] != 0)) bnd.push_back(k * h + i + 1);
+            }
+            for (; j < w; ++j)
+                if ((r0[j] != 0) != (r1[j] != 0)) bnd.push_back(j * h + i + 1);
+        }
+        const uint8_t* last = M + (h - 1) * w;
+        for (int64_t j = 0; j + 1 < w; ++j)
+            if ((last[j] != 0) != (M[j + 1] != 0)) bnd.push_back((j + 1) * h);
+        std::sort(bnd.begin(), bnd.end());
+        if (M[0] != 0) out[n_runs++] = 0;  // RLE starts with the zero run
+        int64_t prev = 0;
+        for (const int64_t p : bnd) {
+            out[n_runs++] = (uint32_t)(p - prev);
+            prev = p;
+        }
+        out[n_runs++] = (uint32_t)(h * w - prev);
+        runcounts[m] = n_runs;
+        total += n_runs;
+    }
+    return total;
+}
+
 void mtpu_rle_decode(const uint32_t* counts, int64_t n_runs, uint8_t* mask, int64_t n) {
     int64_t pos = 0;
     uint8_t v = 0;
@@ -88,6 +160,16 @@ int64_t mtpu_rle_area(const uint32_t* counts, int64_t n_runs) {
     int64_t area = 0;
     for (int64_t r = 1; r < n_runs; r += 2) area += counts[r];
     return area;
+}
+
+// Per-mask areas over concatenated run arrays in one call.
+void mtpu_rle_area_batch(const uint32_t* runs, const int64_t* runcounts,
+                         int64_t n_masks, double* out) {
+    int64_t off = 0;
+    for (int64_t m = 0; m < n_masks; ++m) {
+        out[m] = (double)mtpu_rle_area(runs + off, runcounts[m]);
+        off += runcounts[m];
+    }
 }
 
 // Intersection area of two RLEs over the same canvas.
@@ -262,6 +344,65 @@ void mtpu_coco_match_blocks(const double* ious, const int64_t* nd, const int64_t
         iou_off += NDb * NGb;
         d_off += NDb;
         g_off += NGb;
+    }
+}
+
+// COCO precision/recall tables for all class segments of one (area, max_det)
+// cell in one call.  codes is the raw (n_thr, n_col_full) uint8 match-code
+// table; `cols` (n_cols) selects and orders the columns by (class, score
+// desc) — the kernel gathers on the fly, so the caller never materializes
+// the reordered table.  Per-class segments live at seg_starts/seg_sizes
+// (positions into `cols`); dout marks detections outside the area range
+// (not counted as FP), indexed by original column id.  For every segment
+// with npig > 0: cumulative TP/FP over score rank, recall at the last rank,
+// monotone non-increasing precision envelope, and the R-point interpolation
+// at rec_thrs (searchsorted-left semantics, matching pycocotools).
+// Outputs: out_prec (n_thr, n_rec, n_seg), out_rec (n_thr, n_seg); segments
+// with npig <= 0 are left untouched.
+void mtpu_coco_tables(const uint8_t* codes, int64_t n_col_full,
+                      const int64_t* cols, const uint8_t* dout,
+                      const int64_t* seg_starts, const int64_t* seg_sizes,
+                      const double* npig, const double* rec_thrs,
+                      int64_t n_thr, int64_t n_seg, int64_t n_rec,
+                      double* out_prec, double* out_rec) {
+    // Recall/precision only change at TP steps, and searchsorted-left over a
+    // step function always lands on a step position (the zero-tp prefix it
+    // can land on has pr == 0, never the suffix max), so it suffices to
+    // record rc/pr at the steps: O(#matches) float work over an O(#dets)
+    // integer scan, outputs identical to the dense formulation.
+    int64_t max_n = 0;
+    for (int64_t s = 0; s < n_seg; ++s) max_n = std::max(max_n, seg_sizes[s]);
+    std::vector<double> rcs(max_n), prs(max_n);
+    for (int64_t s = 0; s < n_seg; ++s) {
+        if (!(npig[s] > 0)) continue;
+        const int64_t start = seg_starts[s], n = seg_sizes[s];
+        const int64_t* I = cols + start;
+        for (int64_t t = 0; t < n_thr; ++t) {
+            const uint8_t* C = codes + t * n_col_full;
+            int64_t tp = 0, fp = 0, ns = 0;
+            for (int64_t i = 0; i < n; ++i) {
+                const uint8_t v = C[I[i]];
+                if (v == 1) {
+                    ++tp;
+                    rcs[ns] = (double)tp / npig[s];
+                    prs[ns] = (double)tp / (double)(tp + fp);
+                    ++ns;
+                } else if (v == 0 && !dout[I[i]]) {
+                    ++fp;
+                }
+            }
+            out_rec[t * n_seg + s] = (double)tp / npig[s];
+            // monotone non-increasing precision envelope over the steps
+            for (int64_t i = ns - 2; i >= 0; --i) prs[i] = std::max(prs[i], prs[i + 1]);
+            // rec_thrs ascends: searchsorted-left over all thresholds is one
+            // monotone merge, O(#steps + R)
+            double* P = out_prec + t * n_rec * n_seg;
+            int64_t idx = 0;
+            for (int64_t r = 0; r < n_rec; ++r) {
+                while (idx < ns && rcs[idx] < rec_thrs[r]) ++idx;
+                P[r * n_seg + s] = idx < ns ? prs[idx] : 0.0;
+            }
+        }
     }
 }
 

@@ -143,6 +143,8 @@ def _movedim(x: Array, source: int, destination: int) -> Array:
 def _squeeze_scalar_element_tensor(x: Array) -> Array:
     if not hasattr(x, "size"):  # plain Python leaves (str/float) pass through
         return x
+    if getattr(x, "ndim", None) == 0:  # already scalar: skip the squeeze
+        return x  # (an eager squeeze dispatch would compile a program)
     return x.squeeze() if x.size == 1 else x
 
 

@@ -518,3 +518,93 @@ class TestSegmIrregularDenseOracle:
         want = self._dense_reference_map(preds, targets, thresholds, rec_thrs)
         for key, val in want.items():
             assert abs(float(out[key]) - val) < 1e-6, (key, float(out[key]), val)
+
+
+class TestRound4NativeKernels:
+    """Round-4 batched kernels: batch RLE encode and segmented tables."""
+
+    def test_rle_encode_batch_matches_single(self):
+        from metrics_tpu._native import native_available, rle_encode, rle_encode_batch
+
+        if not native_available():
+            pytest.skip("native library unavailable")  # fallback IS rle_encode
+        rng = np.random.default_rng(41)
+        shapes = [(1, 1), (3, 100), (100, 3), (64, 80), (7, 7)]
+        for h, w in shapes:
+            masks = (rng.random((5, h, w)) < rng.random()).astype(np.uint8)
+            masks[0] = 0
+            masks[1] = 1
+            runs, counts = rle_encode_batch(masks)
+            off = 0
+            for i, m in enumerate(masks):
+                want = rle_encode(m)
+                got = runs[off : off + counts[i]]
+                np.testing.assert_array_equal(got, want)
+                off += counts[i]
+            assert off == len(runs)
+
+    def test_coco_tables_native_matches_python_fallback(self):
+        from metrics_tpu._native import coco_tables, native_available
+
+        if not native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(42)
+        T, N = 10, 400
+        codes = rng.integers(0, 3, (T, N)).astype(np.uint8)
+        cols = rng.permutation(N).astype(np.int64)
+        dout = rng.random(N) < 0.3
+        # three segments of uneven sizes over the column positions
+        starts = np.asarray([0, 150, 260], np.int64)
+        sizes = np.asarray([150, 110, 140], np.int64)
+        npig = np.asarray([37.0, 0.0, 4.0])
+        rec_thrs = np.asarray([0.01 * i for i in range(101)])
+        prec_n, rec_n = coco_tables(codes, cols, dout, starts, sizes, npig, rec_thrs)
+        prec_p, rec_p = MeanAveragePrecision._tables_segments_py(
+            codes[:, cols], dout[cols], starts, sizes, npig, rec_thrs
+        )
+        np.testing.assert_allclose(prec_n, prec_p, atol=0)
+        np.testing.assert_allclose(rec_n, rec_p, atol=0)
+
+    def test_full_pipeline_native_vs_python_fallback(self):
+        import metrics_tpu._native as native_mod
+
+        if not native_mod.native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(43)
+        preds, targets = [], []
+        for _ in range(6):
+            n_g, n_d = 5, 9
+            gt = np.sort(rng.random((n_g, 2, 2)) * 200, axis=1).reshape(n_g, 4)
+            det = np.concatenate([gt + rng.normal(scale=4, size=(n_g, 4)),
+                                  np.sort(rng.random((n_d - n_g, 2, 2)) * 200, axis=1).reshape(-1, 4)])
+            preds.append(dict(boxes=det, scores=rng.random(n_d), labels=rng.integers(0, 4, n_d)))
+            targets.append(dict(boxes=gt, labels=rng.integers(0, 4, n_g)))
+
+        def run():
+            m = MeanAveragePrecision(class_metrics=True)
+            m.update(preds, targets)
+            return {k: np.asarray(v) for k, v in m.compute().items()}
+
+        with_native = run()
+        saved = native_mod._LIB
+        try:
+            native_mod._LIB = None
+            without_native = run()
+        finally:
+            native_mod._LIB = saved
+        for key in with_native:
+            np.testing.assert_allclose(
+                with_native[key], without_native[key], atol=1e-9, err_msg=key
+            )
+
+    def test_max_det_zero_keeps_zero_not_sentinel(self):
+        # a 0 cap must yield 0.0 recall (empty det set), not the -1 sentinel
+        preds = [dict(boxes=np.asarray([[10.0, 10.0, 60.0, 60.0]]),
+                      scores=np.asarray([0.9]), labels=np.asarray([0]))]
+        target = [dict(boxes=np.asarray([[12.0, 12.0, 58.0, 58.0]]),
+                       labels=np.asarray([0]))]
+        m = MeanAveragePrecision(max_detection_thresholds=[0, 100])
+        m.update(preds, target)
+        out = m.compute()
+        assert float(out["mar_0"]) == 0.0
+        assert float(out["mar_100"]) == pytest.approx(0.7)  # IoU .846 -> 7/10 thresholds
