@@ -45,8 +45,14 @@ class TestBF16:
         m.update(jnp.ones(4), jnp.zeros(4))
         m.half()
         assert m._jitted_update is None
+        assert m._dtype is jnp.bfloat16
         m.update(jnp.ones(4, jnp.bfloat16), jnp.zeros(4, jnp.bfloat16))
-        assert jnp.issubdtype(m.sum_squared_error.dtype, jnp.bfloat16) or True  # runs without dtype clash
+        # bf16 inputs accumulate without a dtype clash; the accumulator
+        # itself upcasts to f32 BY DESIGN (bf16 sums lose mass over long
+        # streams — the reference's fp16 path upcasts identically,
+        # reference utilities/checks.py:405-408)
+        assert m.sum_squared_error.dtype == jnp.float32
+        assert float(m.compute()) == 1.0
 
 
 def _finite_diff(fn, x, eps=1e-3):

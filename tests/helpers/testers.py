@@ -205,5 +205,119 @@ class MetricTester:
             _assert_allclose(rank_val, ref_total, atol=atol)
 
 
+    # -------------------------------------------------- precision (bf16)
+    def run_precision_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: Optional[type] = None,
+        metric_functional: Optional[Callable] = None,
+        metric_args: Optional[Dict[str, Any]] = None,
+        functional_args: Optional[Dict[str, Any]] = None,
+        atol: float = 1e-2,
+        rtol: float = 5e-2,
+    ) -> None:
+        """bf16 inputs must run AND agree with the f32 result.
+
+        The reference's half-precision pass only asserts the fp16 call
+        returns a tensor (``tests/unittests/helpers/testers.py:303-332``);
+        on TPU the half dtype is bfloat16 and the stronger check is value
+        agreement within bf16 tolerance (~8 mantissa bits).
+        """
+        metric_args = metric_args or {}
+        functional_args = metric_args if functional_args is None else functional_args
+
+        def cast(x: Any, dtype: Any) -> jax.Array:
+            arr = jnp.asarray(x)
+            return arr.astype(dtype) if jnp.issubdtype(arr.dtype, jnp.floating) else arr
+
+        def to_f64(tree: Any) -> Any:
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(x, np.float64) if hasattr(x, "dtype") else x, tree
+            )
+
+        p0, t0 = preds[0], target[0]  # one batch suffices for dtype coverage
+        if metric_class is not None:
+            vals = {}
+            for dtype in (jnp.float32, jnp.bfloat16):
+                metric = metric_class(**metric_args)
+                metric.update(cast(p0, dtype), cast(t0, dtype))
+                vals[str(dtype.__name__)] = metric.compute()
+            np.testing.assert_allclose(
+                np.asarray(jax.tree_util.tree_leaves(to_f64(vals["bfloat16"]))),
+                np.asarray(jax.tree_util.tree_leaves(to_f64(vals["float32"]))),
+                atol=atol,
+                rtol=rtol,
+            )
+        if metric_functional is not None:
+            out_low = metric_functional(cast(p0, jnp.bfloat16), cast(t0, jnp.bfloat16), **functional_args)
+            out_full = metric_functional(cast(p0, jnp.float32), cast(t0, jnp.float32), **functional_args)
+            np.testing.assert_allclose(
+                np.asarray(jax.tree_util.tree_leaves(to_f64(out_low))),
+                np.asarray(jax.tree_util.tree_leaves(to_f64(out_full))),
+                atol=atol,
+                rtol=rtol,
+            )
+
+    # ---------------------------------------------- differentiability
+    def run_differentiability_test(
+        self,
+        preds: Any,
+        target: Any,
+        metric_class: type,
+        metric_functional: Optional[Callable] = None,
+        metric_args: Optional[Dict[str, Any]] = None,
+        functional_args: Optional[Dict[str, Any]] = None,
+        n_probe: int = 6,
+        eps: float = 1e-3,
+        atol: float = 5e-2,
+    ) -> None:
+        """``jax.grad`` through the functional vs central finite differences.
+
+        The reference checks ``requires_grad`` consistency and runs
+        ``torch.autograd.gradcheck`` when ``is_differentiable``
+        (``tests/unittests/helpers/testers.py:536-570``); the JAX analog
+        probes ``n_probe`` random coordinates of the gradient against
+        finite differences (full gradcheck over every element is O(size)
+        recompiles for no extra signal).
+        """
+        metric_args = metric_args or {}
+        functional_args = metric_args if functional_args is None else functional_args
+        metric = metric_class(**metric_args)
+        p0 = jnp.asarray(np.asarray(preds[0], np.float32))
+        t0 = jnp.asarray(target[0])
+        if not metric.is_differentiable or metric_functional is None:
+            return
+        if not jnp.issubdtype(p0.dtype, jnp.floating):
+            return
+
+        def scalar_fn(p: jax.Array) -> jax.Array:
+            out = metric_functional(p, t0, **functional_args)
+            leaves = [
+                jnp.sum(leaf)
+                for leaf in jax.tree_util.tree_leaves(out)
+                if hasattr(leaf, "dtype") and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+            ]
+            return sum(leaves[1:], leaves[0])
+
+        grad = np.asarray(jax.grad(scalar_fn)(p0), np.float64)
+        assert np.isfinite(grad).all(), "gradient contains non-finite entries"
+        rng = np.random.default_rng(0)
+        flat = np.asarray(p0, np.float64).ravel()
+        idxs = rng.choice(flat.size, size=min(n_probe, flat.size), replace=False)
+        for i in idxs:
+            up, down = flat.copy(), flat.copy()
+            up[i] += eps
+            down[i] -= eps
+            fd = (
+                float(scalar_fn(jnp.asarray(up.reshape(p0.shape), jnp.float32)))
+                - float(scalar_fn(jnp.asarray(down.reshape(p0.shape), jnp.float32)))
+            ) / (2 * eps)
+            got = grad.ravel()[i]
+            assert abs(got - fd) <= atol + 0.05 * abs(fd), (
+                f"grad mismatch at flat index {i}: jax.grad={got}, finite-diff={fd}"
+            )
+
+
 class DummyMetric:
     """Placeholder import guard; real dummies live in tests/bases."""

@@ -1,0 +1,180 @@
+"""Systematic bf16-precision and differentiability sweep across domains.
+
+The reference applies ``run_precision_test_cpu/gpu`` and
+``run_differentiability_test`` to essentially every metric
+(``tests/unittests/helpers/testers.py:478-570``); this sweep is the TPU
+equivalent over the shared :class:`MetricTester` harness — bf16 agreement
+with f32 within bf16 tolerance, and ``jax.grad`` vs central finite
+differences where ``is_differentiable``.
+
+Classification inputs keep a margin from decision boundaries (threshold 0.5,
+argmax ties) so bf16 rounding cannot flip a sample — the sweep asserts value
+agreement, not flip luck.
+"""
+
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as F
+from metrics_tpu import (
+    Accuracy,
+    CosineSimilarity,
+    Dice,
+    ExplainedVariance,
+    F1Score,
+    HammingDistance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    Precision,
+    R2Score,
+    Recall,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalNoiseRatio,
+    Specificity,
+    SpearmanCorrCoef,
+    StructuralSimilarityIndexMeasure,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    UniversalImageQualityIndex,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.classification import ConfusionMatrix
+from metrics_tpu.image import PeakSignalNoiseRatio, SpectralAngleMapper
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(1234)
+_N_BATCH, _B = 2, 32
+
+# binary probabilities with a margin around the 0.5 threshold
+_bin_truth = _rng.integers(0, 2, (_N_BATCH, _B))
+_bin_probs = np.where(
+    _rng.random((_N_BATCH, _B)) < 0.8, _bin_truth, 1 - _bin_truth
+) * 0.7 + 0.1 + 0.1 * _rng.random((_N_BATCH, _B))
+_bin_probs = _bin_probs.astype(np.float32)
+
+# multiclass logits with a clear argmax margin
+_mc_target = _rng.integers(0, 5, (_N_BATCH, _B))
+_mc_logits = (
+    np.eye(5, dtype=np.float32)[_rng.integers(0, 5, (_N_BATCH, _B))] * 4.0
+    + 0.3 * _rng.random((_N_BATCH, _B, 5), dtype=np.float32)
+)
+
+_reg_preds = (_rng.random((_N_BATCH, _B), dtype=np.float32) + 0.5).astype(np.float32)
+_reg_target = (_rng.random((_N_BATCH, _B), dtype=np.float32) + 0.5).astype(np.float32)
+_vec_preds = (_rng.random((_N_BATCH, _B, 4), dtype=np.float32) + 0.5).astype(np.float32)
+_vec_target = (_rng.random((_N_BATCH, _B, 4), dtype=np.float32) + 0.5).astype(np.float32)
+
+_img_preds = _rng.random((_N_BATCH, 2, 1, 16, 16), dtype=np.float32)
+_img_target = _rng.random((_N_BATCH, 2, 1, 16, 16), dtype=np.float32)
+_img4_preds = _rng.random((_N_BATCH, 2, 3, 16, 16), dtype=np.float32)
+_img4_target = _rng.random((_N_BATCH, 2, 3, 16, 16), dtype=np.float32)
+
+_audio_preds = _rng.normal(size=(_N_BATCH, 2, 64)).astype(np.float32)
+_audio_target = _rng.normal(size=(_N_BATCH, 2, 64)).astype(np.float32)
+
+
+_PRECISION_CASES = [
+    # --- classification (binary probs with margin)
+    ("accuracy_binary", Accuracy, F.accuracy, {}, _bin_probs, _bin_truth, {}),
+    ("precision_binary", Precision, F.precision, {}, _bin_probs, _bin_truth, {}),
+    ("recall_binary", Recall, F.recall, {}, _bin_probs, _bin_truth, {}),
+    ("f1_binary", F1Score, F.f1_score, {}, _bin_probs, _bin_truth, {}),
+    ("specificity_binary", Specificity, F.specificity, {}, _bin_probs, _bin_truth, {}),
+    ("hamming_binary", HammingDistance, F.hamming_distance, {}, _bin_probs, _bin_truth, {}),
+    ("dice_binary", Dice, F.dice, {}, _bin_probs, _bin_truth, {}),
+    (
+        "accuracy_multiclass", Accuracy, F.accuracy,
+        {"num_classes": 5}, _mc_logits, _mc_target, {},
+    ),
+    (
+        "confusion_matrix", ConfusionMatrix, F.confusion_matrix,
+        {"num_classes": 2}, _bin_probs, _bin_truth, {},
+    ),
+    # --- regression
+    ("mse", MeanSquaredError, F.mean_squared_error, {}, _reg_preds, _reg_target, {}),
+    ("mae", MeanAbsoluteError, F.mean_absolute_error, {}, _reg_preds, _reg_target, {}),
+    ("msle", MeanSquaredLogError, F.mean_squared_log_error, {}, _reg_preds, _reg_target, {}),
+    ("mape", MeanAbsolutePercentageError, F.mean_absolute_percentage_error, {}, _reg_preds, _reg_target, {}),
+    ("smape", SymmetricMeanAbsolutePercentageError, F.symmetric_mean_absolute_percentage_error, {}, _reg_preds, _reg_target, {}),
+    ("wmape", WeightedMeanAbsolutePercentageError, F.weighted_mean_absolute_percentage_error, {}, _reg_preds, _reg_target, {}),
+    ("cosine", CosineSimilarity, F.cosine_similarity, {}, _vec_preds, _vec_target, {}),
+    ("explained_variance", ExplainedVariance, F.explained_variance, {}, _reg_preds, _reg_target, {}),
+    ("pearson", PearsonCorrCoef, F.pearson_corrcoef, {}, _reg_preds, _reg_target, {}),
+    ("spearman", SpearmanCorrCoef, F.spearman_corrcoef, {}, _reg_preds, _reg_target, {"atol": 5e-2}),
+    ("r2", R2Score, F.r2_score, {}, _reg_preds, _reg_target, {}),
+    ("tweedie", TweedieDevianceScore, F.tweedie_deviance_score, {}, _reg_preds, _reg_target, {}),
+    # --- image
+    (
+        "psnr", PeakSignalNoiseRatio, F.peak_signal_noise_ratio,
+        {"data_range": 1.0}, _img_preds, _img_target, {},
+    ),
+    (
+        "ssim", StructuralSimilarityIndexMeasure, F.structural_similarity_index_measure,
+        {"data_range": 1.0}, _img_preds, _img_target, {},
+    ),
+    ("uqi", UniversalImageQualityIndex, F.universal_image_quality_index, {}, _img_preds, _img_target, {}),
+    ("sam", SpectralAngleMapper, F.spectral_angle_mapper, {}, _img4_preds, _img4_target, {}),
+    # --- audio
+    ("snr", SignalNoiseRatio, F.signal_noise_ratio, {}, _audio_preds, _audio_target, {"atol": 5e-2}),
+    ("si_snr", ScaleInvariantSignalNoiseRatio, F.scale_invariant_signal_noise_ratio, {}, _audio_preds, _audio_target, {"atol": 5e-2}),
+    ("si_sdr", ScaleInvariantSignalDistortionRatio, F.scale_invariant_signal_distortion_ratio, {}, _audio_preds, _audio_target, {"atol": 5e-2}),
+]
+
+
+class TestPrecisionSweep(MetricTester):
+    @pytest.mark.parametrize(
+        "name,metric_class,functional,args,preds,target,tol",
+        _PRECISION_CASES,
+        ids=[c[0] for c in _PRECISION_CASES],
+    )
+    def test_bf16_agrees_with_f32(self, name, metric_class, functional, args, preds, target, tol):
+        self.run_precision_test(
+            preds, target, metric_class=metric_class, metric_functional=functional,
+            metric_args=args, **tol,
+        )
+
+
+_GRAD_CASES = [
+    ("mse", MeanSquaredError, F.mean_squared_error, {}, _reg_preds, _reg_target),
+    ("mae", MeanAbsoluteError, F.mean_absolute_error, {}, _reg_preds, _reg_target),
+    ("msle", MeanSquaredLogError, F.mean_squared_log_error, {}, _reg_preds, _reg_target),
+    ("mape", MeanAbsolutePercentageError, F.mean_absolute_percentage_error, {}, _reg_preds, _reg_target),
+    ("smape", SymmetricMeanAbsolutePercentageError, F.symmetric_mean_absolute_percentage_error, {}, _reg_preds, _reg_target),
+    ("wmape", WeightedMeanAbsolutePercentageError, F.weighted_mean_absolute_percentage_error, {}, _reg_preds, _reg_target),
+    ("cosine", CosineSimilarity, F.cosine_similarity, {}, _vec_preds, _vec_target),
+    ("explained_variance", ExplainedVariance, F.explained_variance, {}, _reg_preds, _reg_target),
+    ("pearson", PearsonCorrCoef, F.pearson_corrcoef, {}, _reg_preds, _reg_target),
+    ("r2", R2Score, F.r2_score, {}, _reg_preds, _reg_target),
+    ("tweedie", TweedieDevianceScore, F.tweedie_deviance_score, {}, _reg_preds, _reg_target),
+    ("psnr", PeakSignalNoiseRatio, F.peak_signal_noise_ratio, {"data_range": 1.0}, _img_preds, _img_target),
+    ("ssim", StructuralSimilarityIndexMeasure, F.structural_similarity_index_measure, {"data_range": 1.0}, _img_preds, _img_target),
+    ("snr", SignalNoiseRatio, F.signal_noise_ratio, {}, _audio_preds, _audio_target),
+    ("si_snr", ScaleInvariantSignalNoiseRatio, F.scale_invariant_signal_noise_ratio, {}, _audio_preds, _audio_target),
+    ("si_sdr", ScaleInvariantSignalDistortionRatio, F.scale_invariant_signal_distortion_ratio, {}, _audio_preds, _audio_target),
+]
+
+
+class TestDifferentiabilitySweep(MetricTester):
+    @pytest.mark.parametrize(
+        "name,metric_class,functional,args,preds,target",
+        _GRAD_CASES,
+        ids=[c[0] for c in _GRAD_CASES],
+    )
+    def test_grad_matches_finite_differences(self, name, metric_class, functional, args, preds, target):
+        self.run_differentiability_test(
+            preds, target, metric_class, metric_functional=functional, metric_args=args,
+        )
+
+    @pytest.mark.parametrize(
+        "name,metric_class",
+        [(c[0], c[1]) for c in _PRECISION_CASES[:9]],
+        ids=[c[0] for c in _PRECISION_CASES[:9]],
+    )
+    def test_classification_flags_not_differentiable(self, name, metric_class):
+        # counting metrics declare is_differentiable=False; the sweep relies
+        # on the flag to skip them, so pin it
+        assert metric_class.is_differentiable is False
