@@ -21,7 +21,7 @@ import os
 from typing import Dict, Optional
 
 INCEPTION_FILE = "inception_fid.npz"
-LPIPS_FILES = {"vgg": "lpips_vgg.npz", "alex": "lpips_alex.npz"}
+LPIPS_FILES = {"vgg": "lpips_vgg.npz", "alex": "lpips_alex.npz", "squeeze": "lpips_squeeze.npz"}
 
 
 def weight_search_paths(filename: str) -> list:

@@ -3,9 +3,10 @@
 Learned Perceptual Image Patch Similarity: deep features from several
 backbone stages, channel-unit-normalized, squared difference weighted by
 learned 1x1 heads, spatially averaged, summed over stages.  The backbone is
-a first-party Flax module (VGG-style or AlexNet-style stacks mirroring the
-stages the ``lpips`` package taps); pass converted ``lpips_params`` for
-score parity, or any callable ``net(img1, img2) -> (N,)`` for a custom net.
+a first-party Flax module (VGG16, AlexNet, or SqueezeNet-1.1 stacks
+mirroring the stages the ``lpips`` package taps); pass converted
+``lpips_params`` for score parity, or any callable
+``net(img1, img2) -> (N,)`` for a custom net.
 """
 
 from typing import Any, Callable, Optional
@@ -25,14 +26,51 @@ _SHIFT = jnp.asarray([-0.030, -0.088, -0.188])
 _SCALE = jnp.asarray([0.458, 0.448, 0.450])
 
 
+def _max_pool_ceil(x: Array, window: int = 3, stride: int = 2) -> Array:
+    """torch ``MaxPool2d(ceil_mode=True)`` semantics: pad right/bottom so the
+    last partial window is kept (flax pads max-pool with -inf)."""
+    pads = []
+    for dim in (x.shape[1], x.shape[2]):
+        out = -(-(dim - window) // stride) + 1  # ceil
+        pads.append((0, max(0, (out - 1) * stride + window - dim)))
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding=pads)
+
+
+class _Fire(nn.Module):
+    """SqueezeNet Fire module: 1x1 squeeze, then concat(1x1, 3x3) expands,
+    relu after every conv (torchvision ``squeezenet1_1`` layout)."""
+
+    squeeze_ch: int
+    expand_ch: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        s = nn.relu(nn.Conv(self.squeeze_ch, (1, 1), name="squeeze")(x))
+        e1 = nn.relu(nn.Conv(self.expand_ch, (1, 1), name="expand1x1")(s))
+        e3 = nn.relu(nn.Conv(self.expand_ch, (3, 3), padding=1, name="expand3x3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+# torchvision squeezenet1_1 ``features`` indices of the Fire modules, their
+# (squeeze, expand) widths, where the ceil-mode max pools sit, and which fire
+# outputs the lpips package taps (slices 2-7; slice 1 is conv1+relu)
+_SQUEEZE_FIRE_SPECS = {
+    3: (16, 64), 4: (16, 64), 6: (32, 128), 7: (32, 128),
+    9: (48, 192), 10: (48, 192), 11: (64, 256), 12: (64, 256),
+}
+_SQUEEZE_POOL_BEFORE = (3, 6, 9)
+_SQUEEZE_TAP_AFTER = (4, 7, 9, 10, 11, 12)
+
+
 class _LpipsBackbone(nn.Module):
     """Backbone + learned linear heads, returns the per-pair LPIPS distance.
 
     ``vgg`` is the VGG16 feature stack tapped at relu{1_2, 2_2, 3_3, 4_3,
     5_3}; ``alex`` is the real AlexNet stack (11x11 s4, 5x5, 3x3 convs)
-    tapped after each relu — both structurally accept converted pretrained
-    weights.  ``squeeze`` is a VGG-style stand-in (Fire modules are not
-    reproduced), usable for relative comparisons only.
+    tapped after each relu; ``squeeze`` is the real squeezenet1_1 stack
+    (conv1 + 8 Fire modules, ceil-mode pools) tapped at the 7 lpips slice
+    boundaries — all three structurally accept converted pretrained weights
+    (reference ``image/lpip.py:23-43`` supports the same three backbones).
     """
 
     net_type: str = "vgg"
@@ -42,7 +80,18 @@ class _LpipsBackbone(nn.Module):
         def dual(layer, a, b):
             return nn.relu(layer(a)), nn.relu(layer(b))
 
-        if self.net_type == "alex":
+        if self.net_type == "squeeze":
+            conv = nn.Conv(64, (3, 3), (2, 2), padding=0, name="conv0")
+            x0, x1 = dual(conv, x0, x1)
+            yield x0, x1
+            for idx, (s_ch, e_ch) in _SQUEEZE_FIRE_SPECS.items():
+                if idx in _SQUEEZE_POOL_BEFORE:
+                    x0, x1 = _max_pool_ceil(x0), _max_pool_ceil(x1)
+                fire = _Fire(s_ch, e_ch, name=f"fire{idx}")
+                x0, x1 = fire(x0), fire(x1)
+                if idx in _SQUEEZE_TAP_AFTER:
+                    yield x0, x1
+        elif self.net_type == "alex":
             specs = [
                 (64, (11, 11), (4, 4), 2),
                 (192, (5, 5), (1, 1), 2),
@@ -57,7 +106,7 @@ class _LpipsBackbone(nn.Module):
                 if i < 2:
                     x0 = nn.max_pool(x0, (3, 3), strides=(2, 2))
                     x1 = nn.max_pool(x1, (3, 3), strides=(2, 2))
-        else:  # vgg16 layout (squeeze reuses it as a structural stand-in)
+        else:  # vgg16 layout
             channels, depths = [64, 128, 256, 512, 512], [2, 2, 3, 3, 3]
             for stage, (ch, depth) in enumerate(zip(channels, depths)):
                 for d in range(depth):
@@ -119,7 +168,7 @@ class LearnedPerceptualImagePatchSimilarity(ChunkedExtractorMixin, Metric):
         if net is None:
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            if lpips_params is None and net_type != "squeeze":
+            if lpips_params is None:
                 from metrics_tpu.image.backbones.weights import load_lpips_params
 
                 lpips_params = load_lpips_params(net_type)
@@ -129,12 +178,6 @@ class LearnedPerceptualImagePatchSimilarity(ChunkedExtractorMixin, Metric):
                     "published numbers. Run `python -m tools.fetch_weights --lpips` once "
                     "(needs network + torch) or pass `lpips_params` for parity.",
                     UserWarning,
-                )
-            elif net_type == "squeeze":
-                raise ValueError(
-                    "`net_type='squeeze'` is a structural stand-in (Fire modules are not "
-                    "reproduced) and cannot load converted SqueezeNet weights; use 'vgg' or "
-                    "'alex' for weight parity."
                 )
             module = _LpipsBackbone(net_type)
             if lpips_params is None:

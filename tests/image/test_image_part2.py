@@ -211,8 +211,11 @@ class TestLPIPS:
         np.testing.assert_allclose(float(m2.compute()), 0.0, atol=1e-5)
 
     def test_sum_reduction_and_normalize(self):
-        img1 = np.random.default_rng(0).random((2, 3, 16, 16)).astype(np.float32)
-        img2 = np.random.default_rng(1).random((2, 3, 16, 16)).astype(np.float32)
+        # the real squeezenet1_1 stack (stride-2 conv + three stride-2 pools)
+        # needs lpips-scale inputs; 16x16 would collapse to an empty grid in
+        # torch too
+        img1 = np.random.default_rng(0).random((2, 3, 64, 64)).astype(np.float32)
+        img2 = np.random.default_rng(1).random((2, 3, 64, 64)).astype(np.float32)
         m = LearnedPerceptualImagePatchSimilarity(net_type="squeeze", reduction="sum", normalize=True)
         m.update(img1, img2)
         assert np.isfinite(float(m.compute()))

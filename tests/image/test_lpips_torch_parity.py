@@ -20,8 +20,10 @@ import jax.numpy as jnp  # noqa: E402
 from metrics_tpu.image.lpip import _SCALE, _SHIFT, _LpipsBackbone  # noqa: E402
 from tools.convert_weights import (  # noqa: E402
     ALEXNET_CONV_INDICES,
+    SQUEEZENET_FIRE_INDICES,
     VGG16_CONV_INDICES,
     convert_lpips_alexnet,
+    convert_lpips_squeezenet,
     convert_lpips_vgg16,
 )
 
@@ -35,6 +37,13 @@ ALEX_SHAPES = [
     (256, 384, 3, 3, 1, 1),
     (256, 256, 3, 3, 1, 1),
 ]
+# squeezenet1_1: fire idx -> (in_ch, squeeze_ch, expand_ch)
+SQUEEZE_FIRE_SHAPES = {
+    3: (64, 16, 64), 4: (128, 16, 64), 6: (128, 32, 128), 7: (256, 32, 128),
+    9: (256, 48, 192), 10: (384, 48, 192), 11: (384, 64, 256), 12: (512, 64, 256),
+}
+SQUEEZE_POOL_BEFORE = {3, 6, 9}
+SQUEEZE_TAP_AFTER = {4, 7, 9, 10, 11, 12}
 
 
 def _torch_lpips_distance(sd, img0, img1, net_type):
@@ -54,6 +63,23 @@ def _torch_lpips_distance(sd, img0, img1, net_type):
             if ordinal in VGG_POOL_AFTER:
                 x0 = F.max_pool2d(x0, 2, 2)
                 x1 = F.max_pool2d(x1, 2, 2)
+    elif net_type == "squeeze":
+        def fire(x, idx):
+            s = F.relu(F.conv2d(x, sd[f"features.{idx}.squeeze.weight"], sd[f"features.{idx}.squeeze.bias"]))
+            e1 = F.relu(F.conv2d(s, sd[f"features.{idx}.expand1x1.weight"], sd[f"features.{idx}.expand1x1.bias"]))
+            e3 = F.relu(F.conv2d(s, sd[f"features.{idx}.expand3x3.weight"], sd[f"features.{idx}.expand3x3.bias"], padding=1))
+            return torch.cat([e1, e3], dim=1)
+
+        x0 = F.relu(F.conv2d(x0, sd["features.0.weight"], sd["features.0.bias"], stride=2))
+        x1 = F.relu(F.conv2d(x1, sd["features.0.weight"], sd["features.0.bias"], stride=2))
+        taps.append((x0, x1))
+        for idx in SQUEEZENET_FIRE_INDICES:
+            if idx in SQUEEZE_POOL_BEFORE:
+                x0 = F.max_pool2d(x0, 3, 2, ceil_mode=True)
+                x1 = F.max_pool2d(x1, 3, 2, ceil_mode=True)
+            x0, x1 = fire(x0, idx), fire(x1, idx)
+            if idx in SQUEEZE_TAP_AFTER:
+                taps.append((x0, x1))
     else:
         for i, (cout, cin, kh, kw, stride, pad) in enumerate(ALEX_SHAPES):
             idx = ALEXNET_CONV_INDICES[i]
@@ -77,6 +103,12 @@ def _torch_lpips_distance(sd, img0, img1, net_type):
 def _fake_state_dict(net_type, seed=0):
     g = torch.Generator().manual_seed(seed)
     sd = {}
+    def rand_conv(prefix, cout, cin, kh, kw):
+        sd[f"{prefix}.weight"] = torch.empty(cout, cin, kh, kw).normal_(
+            0, (2.0 / (cin * kh * kw)) ** 0.5, generator=g
+        )
+        sd[f"{prefix}.bias"] = torch.empty(cout).normal_(0, 0.05, generator=g)
+
     if net_type == "vgg":
         cin = 3
         for idx, cout in zip(VGG16_CONV_INDICES, VGG16_CHANNELS):
@@ -86,6 +118,13 @@ def _fake_state_dict(net_type, seed=0):
             sd[f"features.{idx}.bias"] = torch.empty(cout).normal_(0, 0.05, generator=g)
             cin = cout
         head_ch = (64, 128, 256, 512, 512)
+    elif net_type == "squeeze":
+        rand_conv("features.0", 64, 3, 3, 3)
+        for idx, (cin, s_ch, e_ch) in SQUEEZE_FIRE_SHAPES.items():
+            rand_conv(f"features.{idx}.squeeze", s_ch, cin, 1, 1)
+            rand_conv(f"features.{idx}.expand1x1", e_ch, s_ch, 1, 1)
+            rand_conv(f"features.{idx}.expand3x3", e_ch, s_ch, 3, 3)
+        head_ch = (64, 128, 256, 384, 384, 512, 512)
     else:
         for i, (cout, cin, kh, kw, _, _) in enumerate(ALEX_SHAPES):
             idx = ALEXNET_CONV_INDICES[i]
@@ -99,14 +138,20 @@ def _fake_state_dict(net_type, seed=0):
     return sd
 
 
-@pytest.mark.parametrize("net_type", ["vgg", "alex"])
+@pytest.mark.parametrize("net_type", ["vgg", "alex", "squeeze"])
 def test_lpips_distance_matches_torch(net_type):
     sd = _fake_state_dict(net_type)
-    convert = convert_lpips_vgg16 if net_type == "vgg" else convert_lpips_alexnet
+    convert = {
+        "vgg": convert_lpips_vgg16,
+        "alex": convert_lpips_alexnet,
+        "squeeze": convert_lpips_squeezenet,
+    }[net_type]
     params = convert(sd)
     module = _LpipsBackbone(net_type)
     rng = np.random.default_rng(2)
-    size = 64 if net_type == "vgg" else 96
+    # 94 makes the post-conv1 squeeze grid even (46), forcing the ceil-mode
+    # max-pool padding path the torch stack uses
+    size = {"vgg": 64, "alex": 96, "squeeze": 94}[net_type]
     a = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
     b = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
     with torch.no_grad():

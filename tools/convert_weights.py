@@ -41,6 +41,9 @@ _VGG_FLAX_NAMES: Tuple[str, ...] = (
 )
 _ALEX_FLAX_NAMES: Tuple[str, ...] = ("conv0", "conv1", "conv2", "conv3", "conv4")
 
+# torchvision squeezenet1_1 `features` indices of the Fire modules
+SQUEEZENET_FIRE_INDICES: Tuple[int, ...] = (3, 4, 6, 7, 9, 10, 11, 12)
+
 
 def conv_to_flax(weight: np.ndarray) -> np.ndarray:
     """torch conv kernel OIHW -> flax HWIO."""
@@ -59,18 +62,16 @@ def _to_numpy(x: Any) -> np.ndarray:
     return np.asarray(x)
 
 
-def _lpips_backbone(
-    state_dict: Mapping[str, Any], conv_indices: Tuple[int, ...], flax_names: Tuple[str, ...]
-) -> Dict[str, Any]:
-    """Shared LPIPS conversion: `features.N.{weight,bias}` convs + `linK` heads."""
-    params: Dict[str, Any] = {}
-    for idx, name in zip(conv_indices, flax_names):
-        w = state_dict.get(f"features.{idx}.weight")
-        b = state_dict.get(f"features.{idx}.bias")
-        if w is None or b is None:
-            raise KeyError(f"missing conv weights for features.{idx}")
-        params[name] = {"kernel": conv_to_flax(_to_numpy(w)), "bias": _to_numpy(b)}
-    for stage in range(5):
+def _conv_entry(state_dict: Mapping[str, Any], key: str) -> Dict[str, Any]:
+    w = state_dict.get(f"{key}.weight")
+    b = state_dict.get(f"{key}.bias")
+    if w is None or b is None:
+        raise KeyError(f"missing conv weights for {key}")
+    return {"kernel": conv_to_flax(_to_numpy(w)), "bias": _to_numpy(b)}
+
+
+def _lpips_heads(state_dict: Mapping[str, Any], params: Dict[str, Any], n_heads: int) -> None:
+    for stage in range(n_heads):
         # lpips package naming: lin{K}.model.1.weight; plain: lin{K}.weight
         for key in (f"lin{stage}.model.1.weight", f"lin{stage}.weight"):
             if key in state_dict:
@@ -79,6 +80,17 @@ def _lpips_backbone(
                 break
         else:
             raise KeyError(f"missing LPIPS linear head lin{stage}")
+
+
+def _lpips_backbone(
+    state_dict: Mapping[str, Any], conv_indices: Tuple[int, ...], flax_names: Tuple[str, ...]
+) -> Dict[str, Any]:
+    """Shared LPIPS conversion: `features.N.{weight,bias}` convs + `linK` heads."""
+    params: Dict[str, Any] = {
+        name: _conv_entry(state_dict, f"features.{idx}")
+        for idx, name in zip(conv_indices, flax_names)
+    }
+    _lpips_heads(state_dict, params, 5)
     return params
 
 
@@ -90,6 +102,19 @@ def convert_lpips_vgg16(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
 def convert_lpips_alexnet(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
     """torchvision AlexNet `features.*` + lpips `lin*` -> _LpipsBackbone('alex') params."""
     return _lpips_backbone(state_dict, ALEXNET_CONV_INDICES, _ALEX_FLAX_NAMES)
+
+
+def convert_lpips_squeezenet(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """torchvision squeezenet1_1 `features.*` (conv1 + 8 Fire modules) +
+    lpips `lin0..lin6` -> _LpipsBackbone('squeeze') params."""
+    params: Dict[str, Any] = {"conv0": _conv_entry(state_dict, "features.0")}
+    for idx in SQUEEZENET_FIRE_INDICES:
+        params[f"fire{idx}"] = {
+            sub: _conv_entry(state_dict, f"features.{idx}.{sub}")
+            for sub in ("squeeze", "expand1x1", "expand3x3")
+        }
+    _lpips_heads(state_dict, params, 7)
+    return params
 
 
 def _natural_key(name: str) -> Tuple[str, int]:

@@ -11,7 +11,7 @@ installs them where :mod:`metrics_tpu.image.backbones.weights` discovers them.
 
 Usage (on a machine with network access)::
 
-    python -m tools.fetch_weights --all          # inception + lpips vgg/alex
+    python -m tools.fetch_weights --all          # inception + lpips vgg/alex/squeeze
     python -m tools.fetch_weights --inception
     python -m tools.fetch_weights --lpips
     METRICS_TPU_WEIGHTS_DIR=/my/dir python -m tools.fetch_weights --all
@@ -40,9 +40,11 @@ INCEPTION_URL = (
 )
 VGG16_URL = "https://download.pytorch.org/models/vgg16-397923af.pth"
 ALEXNET_URL = "https://download.pytorch.org/models/alexnet-owt-7be5be79.pth"
+SQUEEZENET_URL = "https://download.pytorch.org/models/squeezenet1_1-b8a52dc0.pth"
 LPIPS_HEADS_URL = {
     "vgg": "https://raw.githubusercontent.com/richzhang/PerceptualSimilarity/master/lpips/weights/v0.1/vgg.pth",
     "alex": "https://raw.githubusercontent.com/richzhang/PerceptualSimilarity/master/lpips/weights/v0.1/alex.pth",
+    "squeeze": "https://raw.githubusercontent.com/richzhang/PerceptualSimilarity/master/lpips/weights/v0.1/squeeze.pth",
 }
 
 
@@ -115,17 +117,26 @@ def fetch_lpips(out_dir: str, cache_dir: str, net_type: str) -> str:
     from metrics_tpu.image.backbones.weights import LPIPS_FILES
     from tools.convert_weights import (
         convert_lpips_alexnet,
+        convert_lpips_squeezenet,
         convert_lpips_vgg16,
         flatten_params,
     )
 
-    backbone_url = VGG16_URL if net_type == "vgg" else ALEXNET_URL
-    heads_channels = (64, 128, 256, 512, 512) if net_type == "vgg" else (64, 192, 384, 256, 256)
+    backbone_url = {"vgg": VGG16_URL, "alex": ALEXNET_URL, "squeeze": SQUEEZENET_URL}[net_type]
+    heads_channels = {
+        "vgg": (64, 128, 256, 512, 512),
+        "alex": (64, 192, 384, 256, 256),
+        "squeeze": (64, 128, 256, 384, 384, 512, 512),
+    }[net_type]
     backbone_sd = _torch_load(download(backbone_url, cache_dir))
     heads_sd = _torch_load(download(LPIPS_HEADS_URL[net_type], cache_dir))
     _validate_lpips_heads(heads_sd, heads_channels)
     merged = {**backbone_sd, **heads_sd}
-    convert = convert_lpips_vgg16 if net_type == "vgg" else convert_lpips_alexnet
+    convert = {
+        "vgg": convert_lpips_vgg16,
+        "alex": convert_lpips_alexnet,
+        "squeeze": convert_lpips_squeezenet,
+    }[net_type]
     params = convert(merged)
     out = os.path.join(out_dir, LPIPS_FILES[net_type])
     os.makedirs(out_dir, exist_ok=True)
@@ -140,7 +151,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--all", action="store_true", help="fetch everything")
     parser.add_argument("--inception", action="store_true", help="FID/IS/KID Inception-v3")
-    parser.add_argument("--lpips", action="store_true", help="LPIPS vgg + alex")
+    parser.add_argument("--lpips", action="store_true", help="LPIPS vgg + alex + squeeze")
     parser.add_argument("--out-dir", default=None, help="install dir (default: discovery path)")
     parser.add_argument("--cache-dir", default=None, help="raw .pth download cache")
     parser.add_argument("--inception-url", default=INCEPTION_URL)
@@ -154,6 +165,7 @@ def main(argv=None) -> int:
     if args.all or args.lpips:
         fetch_lpips(out_dir, cache_dir, "vgg")
         fetch_lpips(out_dir, cache_dir, "alex")
+        fetch_lpips(out_dir, cache_dir, "squeeze")
     print("done")
     return 0
 
