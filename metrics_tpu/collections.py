@@ -354,6 +354,11 @@ class MetricCollection:
         so one pass reaches the fixed point directly.
         """
         groups: List[List[str]] = []
+        for metric in self._modules.values():
+            # state comparison is a read: pending lazy/host sums must land
+            # first, or two unflushed metrics look identically zero
+            metric._flush_pending()
+            metric._flush_host_buffers()
         for name, metric in self._modules.items():
             target = next(
                 (g for g in groups if self._equal_metric_states(self._modules[g[0]], metric)),
@@ -395,6 +400,9 @@ class MetricCollection:
         """Point members at the leader's state arrays (immutable → safe)."""
         for group in self._compute_groups.values():
             leader = self._modules[group[0]]
+            # leaders' pending lazy/host sums must be IN the shared arrays
+            leader._flush_pending()
+            leader._flush_host_buffers()
             if len(group) > 1:
                 # shared buffers must never be donated to a jitted update: a
                 # member's donation would invalidate the aliases every other

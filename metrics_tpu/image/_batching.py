@@ -111,6 +111,7 @@ class ChunkedExtractorMixin:
         self._host_buffers_dirty = self._queue.pending
 
     def _flush_host_buffers(self) -> None:
+        super()._flush_host_buffers()  # pending host scalar sums (base Metric)
         if getattr(self, "_queue", None) is None or getattr(self, "_flushing_images", False):
             return
         self._flushing_images = True

@@ -458,11 +458,51 @@ class Metric(ABC):
         else:
             object.__setattr__(self, name, value)
 
+    def _host_accumulate(self, **increments: Any) -> None:
+        """Fold host-side per-update statistics into named sum states lazily.
+
+        Host-orchestrated metrics (the string metrics: WER, BLEU, ROUGE, ...)
+        produce python-float or small-numpy statistics per update; an eager
+        ``state = state + x`` pays one device dispatch per statistic per
+        call — thousands of round trips over a remote-TPU stream.  The
+        increments buffer host-side (numpy float64) and fold into the
+        device states in one pass at the next state read.
+        """
+        if self._state_swapped:
+            # pure-API context (apply_update on a caller's state pytree):
+            # the increments must land in the SWAPPED state, not buffer on
+            # the instance — an eager/traced add is the correct semantics
+            for name, inc in increments.items():
+                state = self._state[name]
+                self._state[name] = state + jnp.asarray(
+                    np.asarray(inc, np.float64), state.dtype
+                )
+            return
+        acc = self.__dict__.setdefault("_host_scalar_acc", {})
+        for name, inc in increments.items():
+            prev = acc.get(name)
+            inc = np.asarray(inc, np.float64)
+            acc[name] = inc if prev is None else prev + inc
+        self._host_buffers_dirty = True
+
     def _flush_host_buffers(self) -> None:
-        """Subclass hook: fold host-side accumulation buffers (e.g. FID's
-        ``extractor_batch`` image queue) into state.  Called at every READ
-        surface — unlike :meth:`_flush_pending`, never at update entry, so
-        accumulation survives across update calls."""
+        """Fold host-side accumulation buffers into state.  Called at every
+        READ surface — unlike :meth:`_flush_pending`, never at update entry,
+        so accumulation survives across update calls.  The base
+        implementation folds :meth:`_host_accumulate` sums; subclasses with
+        their own buffers (e.g. FID's ``extractor_batch`` image queue)
+        extend it."""
+        if self._state_swapped:
+            # a swapped-in (pure-API) state must never absorb the instance's
+            # pending sums; they belong to the instance's own epoch
+            return
+        acc = self.__dict__.get("_host_scalar_acc")
+        if acc:
+            self.__dict__["_host_scalar_acc"] = {}
+            for name, inc in acc.items():
+                state = self._state[name]
+                self._state[name] = state + jnp.asarray(inc, state.dtype)
+        self._host_buffers_dirty = False
 
     @property
     def state(self) -> Dict[str, Any]:
@@ -1207,6 +1247,7 @@ class Metric(ABC):
     # ----------------------------------------------------------------- sync
     def _copy_state(self) -> Dict[str, Any]:
         self._flush_pending()
+        self._flush_host_buffers()  # snapshots are reads: pending host sums
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
 
     def _restore_state(self, cache: Dict[str, Any]) -> None:
@@ -1322,6 +1363,8 @@ class Metric(ABC):
         """Reset state to defaults (reference ``metric.py:539-554``)."""
         self._pending = []  # pending lazy updates are part of the cleared epoch
         self._pending_sig = None
+        self.__dict__["_host_scalar_acc"] = {}  # pending host sums too
+        self._host_buffers_dirty = False
         self._update_count = 0
         self._computed = None
         self._cache = None

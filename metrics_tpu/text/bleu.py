@@ -45,10 +45,10 @@ class BLEUScore(Metric):
         numerator, denominator, preds_len, target_len = _bleu_score_update(
             preds_, target_, self.n_gram, self._tokenizer()
         )
-        self.preds_len = self.preds_len + preds_len
-        self.target_len = self.target_len + target_len
-        self.numerator = self.numerator + jnp.asarray(numerator, jnp.float32)
-        self.denominator = self.denominator + jnp.asarray(denominator, jnp.float32)
+        self._host_accumulate(
+            preds_len=preds_len, target_len=target_len,
+            numerator=numerator, denominator=denominator,
+        )
 
     def _tokenizer(self):
         return _tokenize_fn

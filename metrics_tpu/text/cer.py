@@ -24,8 +24,7 @@ class CharErrorRate(Metric):
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
         errors, total = _cer_update(preds, target)
-        self.errors = self.errors + errors
-        self.total = self.total + total
+        self._host_accumulate(errors=errors, total=total)
 
     def compute(self) -> Array:
         return _cer_compute(self.errors, self.total)

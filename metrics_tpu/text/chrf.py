@@ -64,12 +64,11 @@ class CHRFScore(Metric):
             preds_, target_, self.n_char_order, self.n_word_order,
             self.beta, self.lowercase, self.whitespace, scores,
         )
-        self.preds_char = self.preds_char + jnp.asarray(p_char, jnp.float32)
-        self.preds_word = self.preds_word + jnp.asarray(p_word, jnp.float32)
-        self.target_char = self.target_char + jnp.asarray(t_char, jnp.float32)
-        self.target_word = self.target_word + jnp.asarray(t_word, jnp.float32)
-        self.matching_char = self.matching_char + jnp.asarray(m_char, jnp.float32)
-        self.matching_word = self.matching_word + jnp.asarray(m_word, jnp.float32)
+        self._host_accumulate(
+            preds_char=p_char, preds_word=p_word,
+            target_char=t_char, target_word=t_word,
+            matching_char=m_char, matching_word=m_word,
+        )
         if self.return_sentence_level_score:
             self.sentence_chrf_score.append(jnp.asarray(scores, jnp.float32))
 

@@ -48,8 +48,7 @@ class ExtendedEditDistance(Metric):
         total, count = _eed_update(
             preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, scores
         )
-        self.score_sum = self.score_sum + total
-        self.score_count = self.score_count + count
+        self._host_accumulate(score_sum=total, score_count=count)
         if self.return_sentence_level_score:
             self.sentence_eed.append(jnp.asarray(scores, jnp.float32))
 

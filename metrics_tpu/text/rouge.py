@@ -83,13 +83,17 @@ class ROUGEScore(Metric):
             preds, target, self.rouge_keys_values, self.accumulate,
             self.stemmer, self.normalizer, self.tokenizer,
         )
+        # host-side lazy accumulation (base ``_host_accumulate``): per-update
+        # eager adds on 0-d device arrays cost one dispatch per (key, stat)
+        # per call — thousands of round trips over a remote-TPU stream
+        inc = {}
         n = 0
         for key, per_stat in stats.items():
             for stat, (total, count) in per_stat.items():
-                name = f"rouge{key}_{stat}_sum"
-                self._state[name] = self._state[name] + total
+                inc[f"rouge{key}_{stat}_sum"] = total
                 n = count
-        self.total = self.total + n
+        inc["total"] = n
+        self._host_accumulate(**inc)
 
     def compute(self) -> Dict[str, Array]:
         denom = jnp.maximum(self.total, 1.0)

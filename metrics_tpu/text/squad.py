@@ -32,9 +32,7 @@ class SQuAD(Metric):
     def update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
         preds_dict, targets_dict = _squad_input_check(preds, target)
         f1, exact_match, total = _squad_update(preds_dict, targets_dict)
-        self.f1_score = self.f1_score + f1
-        self.exact_match = self.exact_match + exact_match
-        self.total = self.total + total
+        self._host_accumulate(f1_score=f1, exact_match=exact_match, total=total)
 
     def compute(self) -> Dict[str, Array]:
         return _squad_compute(self.f1_score, self.exact_match, self.total)

@@ -39,8 +39,7 @@ class TranslationEditRate(Metric):
     ) -> None:
         scores = [] if self.return_sentence_level_score else None
         num_edits, tgt_length = _ter_update(preds, target, self.tokenizer, scores)
-        self.total_num_edits = self.total_num_edits + num_edits
-        self.total_tgt_length = self.total_tgt_length + tgt_length
+        self._host_accumulate(total_num_edits=num_edits, total_tgt_length=tgt_length)
         if self.return_sentence_level_score:
             self.sentence_ter.append(jnp.asarray(scores, jnp.float32))
 

@@ -30,8 +30,7 @@ class WordErrorRate(Metric):
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
         errors, total = _wer_update(preds, target)
-        self.errors = self.errors + errors
-        self.total = self.total + total
+        self._host_accumulate(errors=errors, total=total)
 
     def compute(self) -> Array:
         return _wer_compute(self.errors, self.total)

@@ -25,9 +25,7 @@ class WordInfoLost(Metric):
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
         errors, target_total, preds_total = _wil_update(preds, target)
-        self.errors = self.errors + errors
-        self.target_total = self.target_total + target_total
-        self.preds_total = self.preds_total + preds_total
+        self._host_accumulate(errors=errors, target_total=target_total, preds_total=preds_total)
 
     def compute(self) -> Array:
         return _wil_compute(self.errors, self.target_total, self.preds_total)

@@ -101,7 +101,7 @@ class TestMAPvsPycocotools:
         b = MeanAveragePrecision()
         a.update(PREDS[0], TARGET[0])
         b.update(PREDS[1], TARGET[1])
-        a.merge_state(b._state)
+        a.merge_state(b.state)
         full = MeanAveragePrecision()
         for p, t in zip(PREDS, TARGET):
             full.update(p, t)

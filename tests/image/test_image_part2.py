@@ -102,7 +102,7 @@ class TestFID:
         full = FrechetInceptionDistance(feature=_feature_stub, feature_dim=DIM)
         for i in range(2):
             full.update(IMGS_A[i], real=True); full.update(IMGS_B[i], real=False)
-        a.merge_state(b._state)
+        a.merge_state(b.state)
         np.testing.assert_allclose(float(a.compute()), float(full.compute()), rtol=1e-4)
 
     def test_forward_no_double_count_with_kept_real_features(self):

@@ -69,7 +69,7 @@ class TextTester:
         m1 = metric_class(**metric_args)
         for i in range(n):
             (m0 if i % 2 == 0 else m1).update(preds_batches[i], target_batches[i])
-        m0.merge_state(m1._state, other_count=m1.update_count)
+        m0.merge_state(m1.state, other_count=m1.update_count)
         _assert_close(m0.compute(), ref_total, atol)
 
         # forward: each call returns the metric on THAT batch alone, and the

@@ -262,3 +262,86 @@ class TestBERTScoreFlaxEncoder:
         metric.update(["x y", "p q r"], ["x z", "p q s"])
         out = metric.compute()
         assert len(out["f1"]) == 3
+
+
+class TestHostAccumulation:
+    """Round-4 lazy host-sum accumulation (``Metric._host_accumulate``):
+    per-update device dispatches collapse into one flush per state read.
+    These pin the three interaction bugs the pattern can hit."""
+
+    def test_collection_groups_see_flushed_states(self):
+        from metrics_tpu import MatchErrorRate, MetricCollection, WordErrorRate
+
+        col = MetricCollection({"wer": WordErrorRate(), "mer": MatchErrorRate()})
+        col.update(["hello world"], ["hello there world"])
+        col.update(["a b c"], ["a b c"])
+        out = {k: float(v) for k, v in col.compute().items()}
+        ref_w = WordErrorRate()
+        ref_m = MatchErrorRate()
+        for p, t in ((["hello world"], ["hello there world"]), (["a b c"], ["a b c"])):
+            ref_w.update(p, t)
+            ref_m.update(p, t)
+        assert abs(out["wer"] - float(ref_w.compute())) < 1e-6
+        assert abs(out["mer"] - float(ref_m.compute())) < 1e-6
+
+    def test_apply_compute_foreign_state_does_not_absorb_pending(self):
+        import numpy as np
+
+        from metrics_tpu import WordErrorRate
+
+        m = WordErrorRate()
+        m.update(["a b c"], ["a x c"])  # pending host sums: errors=1, total=3
+        val = float(m.apply_compute({"errors": np.float32(0.0), "total": np.float32(10.0)}))
+        assert val == 0.0  # the foreign state must stay foreign
+        assert float(m.errors) == 1.0 and float(m.total) == 3.0  # instance keeps its epoch
+
+    def test_pure_apply_update_returns_updated_state(self):
+        from metrics_tpu import WordErrorRate
+
+        m = WordErrorRate()
+        s1 = m.apply_update(m.state, ["hello world"], ["hello there world"])
+        assert float(s1["errors"]) == 1.0 and float(s1["total"]) == 3.0
+        assert not m.__dict__.get("_host_scalar_acc")  # nothing leaked
+        assert float(m.errors) == 0.0
+
+    def test_streaming_matches_oneshot_for_all_converted_metrics(self):
+        import numpy as np
+
+        from metrics_tpu import (
+            BLEUScore,
+            CharErrorRate,
+            CHRFScore,
+            ExtendedEditDistance,
+            MatchErrorRate,
+            SQuAD,
+            TranslationEditRate,
+            WordErrorRate,
+            WordInfoLost,
+            WordInfoPreserved,
+        )
+
+        preds = ["the cat sat on the mat", "a quick brown fox", "hello world again"]
+        target = ["the cat sat on a mat", "the quick brown fox", "hello wide world"]
+        for cls, wrap in (
+            (WordErrorRate, False), (CharErrorRate, False), (MatchErrorRate, False),
+            (WordInfoLost, False), (WordInfoPreserved, False),
+            (BLEUScore, True), (CHRFScore, True), (TranslationEditRate, True),
+            (ExtendedEditDistance, True),
+        ):
+            tgt = [[t] for t in target] if wrap else target
+            streamed = cls()
+            for p, t in zip(preds, tgt):
+                streamed.update([p], [t])
+            oneshot = cls()
+            oneshot.update(preds, tgt)
+            np.testing.assert_allclose(
+                np.asarray(streamed.compute(), np.float64),
+                np.asarray(oneshot.compute(), np.float64),
+                atol=1e-6, err_msg=cls.__name__,
+            )
+        squad_p = [{"prediction_text": "paris", "id": "1"}]
+        squad_t = [{"answers": {"answer_start": [0], "text": ["paris"]}, "id": "1"}]
+        sq = SQuAD()
+        sq.update(squad_p, squad_t)
+        out = sq.compute()
+        assert float(out["exact_match"]) == 100.0
