@@ -89,10 +89,14 @@ class BERTScore(Metric):
         self.target_attention_mask.append(jnp.asarray(t_tok["attention_mask"]))
 
     def compute(self) -> Dict[str, List[float]]:
-        p_ids = np.asarray(jnp.concatenate(self.preds_input_ids, axis=0))
-        p_mask = np.asarray(jnp.concatenate(self.preds_attention_mask, axis=0))
-        t_ids = np.asarray(jnp.concatenate(self.target_input_ids, axis=0))
-        t_mask = np.asarray(jnp.concatenate(self.target_attention_mask, axis=0))
+        # token states stay ON DEVICE through the encoder: round-tripping
+        # them through numpy pays a d2h fetch plus one h2d per encoder chunk
+        # (seconds over a remote-TPU tunnel); only the idf path needs host
+        # token ids, and fetches them just then
+        p_ids = jnp.concatenate(self.preds_input_ids, axis=0)
+        p_mask = jnp.concatenate(self.preds_attention_mask, axis=0)
+        t_ids = jnp.concatenate(self.target_input_ids, axis=0)
+        t_mask = jnp.concatenate(self.target_attention_mask, axis=0)
 
         if self.user_forward_fn is not None:
             p_emb = self.user_forward_fn(self.model, p_ids, p_mask)
@@ -102,12 +106,14 @@ class BERTScore(Metric):
             t_emb = _model_forward(self.model, t_ids, t_mask, self.num_layers, self.all_layers, self.batch_size)
 
         if self.idf:
-            weights = _idf_weights(t_ids, t_mask, t_ids.shape[0])
-            pw = _apply_idf(p_ids, p_mask, weights)
-            tw = _apply_idf(t_ids, t_mask, weights)
+            p_ids_np, p_mask_np = np.asarray(p_ids), np.asarray(p_mask)
+            t_ids_np, t_mask_np = np.asarray(t_ids), np.asarray(t_mask)
+            weights = _idf_weights(t_ids_np, t_mask_np, t_ids_np.shape[0])
+            pw = _apply_idf(p_ids_np, p_mask_np, weights)
+            tw = _apply_idf(t_ids_np, t_mask_np, weights)
         else:
-            pw = np.ones(p_ids.shape, dtype=np.float32)
-            tw = np.ones(t_ids.shape, dtype=np.float32)
+            pw = jnp.ones(p_ids.shape, dtype=jnp.float32)
+            tw = jnp.ones(t_ids.shape, dtype=jnp.float32)
 
         out = _run_matching(
             jnp.asarray(p_emb), jnp.asarray(p_mask, jnp.float32),
