@@ -55,7 +55,24 @@ def test_fid_2048_matches_reference_stack():
     assert abs(got - want) < max(0.5, 0.01 * abs(want)), (got, want)
 
 
-@pytest.mark.parametrize("net_type", ["vgg", "alex"])
+def test_map_64_image_fixture_matches_pycocotools():
+    """The 64-image mixed fixture (maxDets truncation, exact area-range
+    boundaries, det-free/gt-free images, score ties) vs the official
+    pycocotools oracle.  Needs only the pinned values, not weights."""
+    from metrics_tpu import MeanAveragePrecision
+    from tools.pin_expected_scores import fixed_map_fixture
+
+    want = _pin("map_coco_64")
+    preds, targets = fixed_map_fixture()
+    metric = MeanAveragePrecision()
+    for start in range(0, len(preds), 8):  # stream like a real eval loop
+        metric.update(preds[start:start + 8], targets[start:start + 8])
+    out = metric.compute()
+    for key, val in want.items():
+        np.testing.assert_allclose(float(out[key]), val, atol=2e-3, err_msg=key)
+
+
+@pytest.mark.parametrize("net_type", ["vgg", "alex", "squeeze"])
 def test_lpips_matches_reference_stack(net_type):
     from metrics_tpu import LearnedPerceptualImagePatchSimilarity
     from metrics_tpu.image.backbones.weights import load_lpips_params

@@ -52,6 +52,58 @@ def fixed_image_pairs(seed: int, n: int = 8, size: int = 64) -> tuple:
     return a, b
 
 
+def fixed_map_fixture(seed: int = 11, n_img: int = 64) -> tuple:
+    """Deterministic 64-image detection fixture hitting the COCO protocol's
+    edges the 4-image pinned subset cannot (VERDICT r3 next #9): maxDets
+    truncation (130-det images), exact area-range boundary boxes (32² and
+    96² areas), det-free and gt-free images, and quantized scores forcing
+    ties.  Both the pycocotools pinning run and the gated test consume THIS
+    generator, so the two stacks always score identical data.
+
+    Returns (preds, targets) in the metric's dict-per-image xyxy format.
+    """
+    rng = np.random.default_rng(seed)
+    canvas = 640.0
+    preds, targets = [], []
+    for i in range(n_img):
+        n_gt = 0 if i % 13 == 0 else int(rng.integers(1, 10))
+        boxes, labels = [], []
+        for _ in range(n_gt):
+            kind = int(rng.integers(0, 4))
+            if kind == 0:
+                w = h = 32.0  # exactly the small/medium area boundary
+            elif kind == 1:
+                w = h = 96.0  # exactly the medium/large area boundary
+            else:
+                w, h = rng.uniform(4, 200, 2)
+            x = rng.uniform(0, canvas - w)
+            y = rng.uniform(0, canvas - h)
+            boxes.append([x, y, x + w, y + h])
+            labels.append(int(rng.integers(0, 7)))
+        gt_boxes = np.asarray(boxes, np.float64).reshape(-1, 4)
+        gt_labels = np.asarray(labels, np.int64)
+        dets, dscores, dlabels = [], [], []
+        if n_gt and i % 7 != 0:  # i % 7 == 0 -> image with no detections
+            n_det = 130 if i % 17 == 0 else int(rng.integers(1, 14))
+            for _ in range(n_det):
+                src = int(rng.integers(0, n_gt))
+                x1, y1, x2, y2 = gt_boxes[src]
+                jit = rng.normal(0, 8, 4)
+                dets.append([
+                    x1 + jit[0], y1 + jit[1],
+                    max(x1 + jit[0] + 4, x2 + jit[2]), max(y1 + jit[1] + 4, y2 + jit[3]),
+                ])
+                dscores.append(round(float(rng.random()), 2))  # 2-decimal ties
+                dlabels.append(int(gt_labels[src]) if rng.random() < 0.8 else int(rng.integers(0, 7)))
+        preds.append(dict(
+            boxes=np.asarray(dets, np.float64).reshape(-1, 4),
+            scores=np.asarray(dscores, np.float64),
+            labels=np.asarray(dlabels, np.int64),
+        ))
+        targets.append(dict(boxes=gt_boxes, labels=gt_labels))
+    return preds, targets
+
+
 def fixed_sentence_pairs() -> tuple:
     preds = [
         "the quick brown fox jumps over the lazy dog",
@@ -95,8 +147,51 @@ def main() -> int:
     except Exception as err:  # noqa: BLE001
         print(f"fid pin skipped: {err}")
 
-    # ---- LPIPS vgg/alex via the lpips package (the reference extractor)
-    for net_type in ("vgg", "alex"):
+    # ---- COCO-protocol mAP on the 64-image mixed fixture via pycocotools
+    # (the official oracle; needs `pip install pycocotools` on the pin box)
+    try:
+        from pycocotools.coco import COCO
+        from pycocotools.cocoeval import COCOeval
+
+        preds, targets = fixed_map_fixture()
+        gt_anns, ann_id = [], 1
+        for i, t in enumerate(targets):
+            for box, label in zip(t["boxes"], t["labels"]):
+                x1, y1, x2, y2 = (float(v) for v in box)
+                gt_anns.append({
+                    "id": ann_id, "image_id": i, "category_id": int(label),
+                    "bbox": [x1, y1, x2 - x1, y2 - y1],
+                    "area": (x2 - x1) * (y2 - y1), "iscrowd": 0,
+                })
+                ann_id += 1
+        coco_gt = COCO()
+        coco_gt.dataset = {
+            "images": [{"id": i} for i in range(len(targets))],
+            "annotations": gt_anns,
+            "categories": [{"id": c} for c in range(7)],
+        }
+        coco_gt.createIndex()
+        dt = []
+        for i, p in enumerate(preds):
+            for box, score, label in zip(p["boxes"], p["scores"], p["labels"]):
+                x1, y1, x2, y2 = (float(v) for v in box)
+                dt.append({
+                    "image_id": i, "category_id": int(label),
+                    "bbox": [x1, y1, x2 - x1, y2 - y1], "score": float(score),
+                })
+        coco_dt = coco_gt.loadRes(dt)
+        ev = COCOeval(coco_gt, coco_dt, "bbox")
+        ev.evaluate()
+        ev.accumulate()
+        ev.summarize()
+        keys = ["map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+                "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large"]
+        pins["map_coco_64"] = {k: float(v) for k, v in zip(keys, ev.stats)}
+    except Exception as err:  # noqa: BLE001
+        print(f"map fixture pin skipped: {err}")
+
+    # ---- LPIPS backbones via the lpips package (the reference extractor)
+    for net_type in ("vgg", "alex", "squeeze"):
         try:
             import lpips
 
