@@ -187,8 +187,13 @@ def _bench_torch_reference(n_batches=_N_LOOPED):
     return (n_batches * _BATCH) / _median_time(run, repeats=3)
 
 
-def _bench_collection(n_batches=256, batch_size=8192, num_classes=10):
-    """Config 2: ConfusionMatrix + F1 collection, fused group updates."""
+def _bench_collection(n_batches=2048, batch_size=8192, num_classes=10):
+    """Config 2: ConfusionMatrix + F1 collection, fused group updates.
+
+    16.8M samples per stream: the round-3 size (2.1M) finished in ~0.2s, so
+    fixed dispatch + round-trip cost dominated the reading (VERDICT r3's
+    11.3M samples/s was an instrument floor, not the collection's rate).
+    """
     import jax
     import jax.numpy as jnp
 

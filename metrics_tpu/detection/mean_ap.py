@@ -144,8 +144,9 @@ class MeanAveragePrecision(Metric):
     ``update(preds, target)`` takes the reference's dict-per-image format:
     ``preds[i] = {boxes (N,4), scores (N,), labels (N,)}``,
     ``target[i] = {boxes (M,4), labels (M,)}`` (plus ``masks`` when
-    ``iou_type='segm'``).  States are per-image list states all-gathered at
-    sync (reference ``mean_ap.py:339-343``).
+    ``iou_type='segm'``).  States are host-side list states (one batched
+    entry per update call, with per-image counts preserving image
+    boundaries) all-gathered at sync (reference ``mean_ap.py:339-343``).
 
     Example:
         >>> import numpy as np
@@ -197,9 +198,10 @@ class MeanAveragePrecision(Metric):
             "medium": (32.0**2, 96.0**2),
             "large": (96.0**2, 1e10),
         }
-        # per-image ragged arrays; the companion *_counts states record image
-        # boundaries so a cat-style all-gather (which flattens the lists)
-        # remains reconstructable — compute() splits the flat arrays by counts
+        # ragged arrays, one batched entry per update call; the companion
+        # *_counts states record per-image boundaries so a cat-style
+        # all-gather (which flattens the lists) remains reconstructable —
+        # compute() splits the flat arrays by counts
         self.add_state("detections", default=[], dist_reduce_fx=None)
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)
@@ -248,33 +250,59 @@ class MeanAveragePrecision(Metric):
         self._input_validator(preds, target, self.iou_type)
         # states stay host-side numpy: the whole protocol is host-orchestrated,
         # and device-resident list entries would pay one device->host transfer
-        # per image per state at compute time (catastrophic over a TPU tunnel)
+        # per image per state at compute time (catastrophic over a TPU tunnel).
+        # Each update appends ONE batched entry per state (with per-image
+        # counts preserving the boundaries) — per-image appends cost tens of
+        # thousands of list ops and array concats at COCO-val scale.
+        if not preds:
+            return
         if self.iou_type == "segm":
             from metrics_tpu._native import rle_encode_batch
-        for item_p, item_t in zip(preds, target):
-            if self.iou_type == "segm":
+
+            d_runs, d_rcs, g_runs, g_rcs = [], [], [], []
+            d_n, g_n = [], []
+            empty = (np.zeros(0, np.uint32), np.zeros(0, np.int64))
+            for item_p, item_t in zip(preds, target):
                 det_masks = np.asarray(item_p["masks"]).astype(np.uint8, copy=False)
                 gt_masks = np.asarray(item_t["masks"]).astype(np.uint8, copy=False)
                 self._check_mask_canvas(det_masks, gt_masks)
-                empty = (np.zeros(0, np.uint32), np.zeros(0, np.int64))
-                det_runs, det_rc = rle_encode_batch(det_masks) if det_masks.ndim == 3 else empty
-                gt_runs, gt_rc = rle_encode_batch(gt_masks) if gt_masks.ndim == 3 else empty
-                self.detection_mask_runs.append(det_runs)
-                self.detection_mask_runcounts.append(det_rc)
-                self.groundtruth_mask_runs.append(gt_runs)
-                self.groundtruth_mask_runcounts.append(gt_rc)
-                det_boxes = np.zeros((len(det_masks), 4))
-                gt_boxes = np.zeros((len(gt_masks), 4))
-            else:
-                det_boxes = box_convert(np.asarray(item_p["boxes"]), self.box_format)
-                gt_boxes = box_convert(np.asarray(item_t["boxes"]), self.box_format)
-            self.detections.append(det_boxes.reshape(-1, 4))
-            self.detection_scores.append(np.array(item_p["scores"], dtype=np.float64, copy=True).reshape(-1))
-            self.detection_labels.append(np.array(item_p["labels"], dtype=np.int64, copy=True).reshape(-1))
-            self.detection_counts.append(np.asarray([det_boxes.shape[0]], np.int32))
-            self.groundtruths.append(gt_boxes.reshape(-1, 4))
-            self.groundtruth_labels.append(np.array(item_t["labels"], dtype=np.int64, copy=True).reshape(-1))
-            self.groundtruth_counts.append(np.asarray([gt_boxes.shape[0]], np.int32))
+                runs, rc = rle_encode_batch(det_masks) if det_masks.ndim == 3 else empty
+                d_runs.append(runs)
+                d_rcs.append(rc)
+                d_n.append(len(rc))
+                runs, rc = rle_encode_batch(gt_masks) if gt_masks.ndim == 3 else empty
+                g_runs.append(runs)
+                g_rcs.append(rc)
+                g_n.append(len(rc))
+            self.detection_mask_runs.append(np.concatenate(d_runs))
+            self.detection_mask_runcounts.append(np.concatenate(d_rcs))
+            self.groundtruth_mask_runs.append(np.concatenate(g_runs))
+            self.groundtruth_mask_runcounts.append(np.concatenate(g_rcs))
+            det_counts = np.asarray(d_n, np.int32)
+            gt_counts = np.asarray(g_n, np.int32)
+            det_boxes = np.zeros((int(det_counts.sum()), 4))
+            gt_boxes = np.zeros((int(gt_counts.sum()), 4))
+        else:
+            d_arrs = [np.asarray(p["boxes"], np.float64).reshape(-1, 4) for p in preds]
+            g_arrs = [np.asarray(t["boxes"], np.float64).reshape(-1, 4) for t in target]
+            det_counts = np.asarray([a.shape[0] for a in d_arrs], np.int32)
+            gt_counts = np.asarray([a.shape[0] for a in g_arrs], np.int32)
+            # one vectorized format conversion over the whole call
+            det_boxes = box_convert(np.concatenate(d_arrs), self.box_format)
+            gt_boxes = box_convert(np.concatenate(g_arrs), self.box_format)
+        self.detections.append(det_boxes)
+        self.detection_scores.append(
+            np.concatenate([np.asarray(p["scores"], np.float64).reshape(-1) for p in preds])
+        )
+        self.detection_labels.append(
+            np.concatenate([np.asarray(p["labels"]).reshape(-1).astype(np.int64) for p in preds])
+        )
+        self.detection_counts.append(det_counts)
+        self.groundtruths.append(gt_boxes)
+        self.groundtruth_labels.append(
+            np.concatenate([np.asarray(t["labels"]).reshape(-1).astype(np.int64) for t in target])
+        )
+        self.groundtruth_counts.append(gt_counts)
 
     @staticmethod
     def _check_mask_canvas(det_masks: np.ndarray, gt_masks: np.ndarray) -> None:
@@ -290,8 +318,8 @@ class MeanAveragePrecision(Metric):
     def _flat_runs(runs_state: Any, runcounts_state: Any) -> Tuple[np.ndarray, np.ndarray]:
         """Whole-epoch flat (runs, per-mask runcounts) from the segm states.
 
-        Pre-sync: one (runs, runcounts) list entry per image — concatenate.
-        Post-sync a collective gather already flattened both.
+        Pre-sync: one (runs, runcounts) list entry per update call —
+        concatenate.  Post-sync a collective gather already flattened both.
         """
         if isinstance(runcounts_state, list):
             runcounts = (
@@ -324,19 +352,6 @@ class MeanAveragePrecision(Metric):
         pos = np.arange(total, dtype=np.int64) - np.repeat(starts, runcounts)
         odd = (pos & 1) == 1
         return np.bincount(mask_id[odd], weights=runs[odd].astype(np.float64), minlength=n_masks)
-
-    @staticmethod
-    def _split_per_image(entries: Any, counts: np.ndarray, tail: Tuple[int, ...]) -> List[np.ndarray]:
-        """Rebuild per-image arrays from the state.
-
-        Pre-sync the state is a Python list with one entry per image; after a
-        collective sync it is one flat concatenated array, which is split
-        back at the recorded per-image counts.
-        """
-        if isinstance(entries, list):
-            return [np.asarray(e).reshape((-1,) + tail) for e in entries]
-        flat = np.asarray(entries).reshape((-1,) + tail)
-        return np.split(flat, np.cumsum(counts)[:-1]) if len(counts) else []
 
     @staticmethod
     def _flat_state(entries: Any, tail: Tuple[int, ...], dtype: Any) -> np.ndarray:
