@@ -308,9 +308,12 @@ def bert_score(
         tw = np.ones(t_tok["input_ids"].shape, dtype=np.float32)
 
     out = _run_matching(
-        jnp.asarray(p_emb), jnp.asarray(p_tok["attention_mask"], jnp.float32),
-        jnp.asarray(t_emb), jnp.asarray(t_tok["attention_mask"], jnp.float32),
-        jnp.asarray(pw), jnp.asarray(tw),
+        # matching always runs f32: a bf16 model (MXU-rate encoding) still
+        # gets f32 cosine similarities and score accumulation (same contract
+        # as the BERTScore class metric)
+        jnp.asarray(p_emb, jnp.float32), jnp.asarray(p_tok["attention_mask"], jnp.float32),
+        jnp.asarray(t_emb, jnp.float32), jnp.asarray(t_tok["attention_mask"], jnp.float32),
+        jnp.asarray(pw, jnp.float32), jnp.asarray(tw, jnp.float32),
     )
     if rescale_with_baseline:
         if baseline_values is None:

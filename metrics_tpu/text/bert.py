@@ -116,9 +116,11 @@ class BERTScore(Metric):
             tw = jnp.ones(t_ids.shape, dtype=jnp.float32)
 
         out = _run_matching(
-            jnp.asarray(p_emb), jnp.asarray(p_mask, jnp.float32),
-            jnp.asarray(t_emb), jnp.asarray(t_mask, jnp.float32),
-            jnp.asarray(pw), jnp.asarray(tw),
+            # matching always runs f32: a bf16 user model (MXU-rate encoding)
+            # still gets f32 cosine similarities and score accumulation
+            jnp.asarray(p_emb, jnp.float32), jnp.asarray(p_mask, jnp.float32),
+            jnp.asarray(t_emb, jnp.float32), jnp.asarray(t_mask, jnp.float32),
+            jnp.asarray(pw, jnp.float32), jnp.asarray(tw, jnp.float32),
         )
         if self.rescale_with_baseline:
             if self.baseline_values is None:

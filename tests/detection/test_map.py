@@ -608,3 +608,55 @@ class TestRound4NativeKernels:
         out = m.compute()
         assert float(out["mar_0"]) == 0.0
         assert float(out["mar_100"]) == pytest.approx(0.7)  # IoU .846 -> 7/10 thresholds
+
+    def test_protocol_param_fuzz_native_vs_fallback(self):
+        """Custom iou/rec thresholds and max-det caps (with score ties and
+        det-free/gt-free images) agree between the native kernels and the
+        pure-python fallbacks."""
+        import metrics_tpu._native as native_mod
+
+        if not native_mod.native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(99)
+
+        def workload(n_img, n_cls):
+            preds, targets = [], []
+            for _ in range(n_img):
+                n_g, n_d = int(rng.integers(0, 6)), int(rng.integers(0, 9))
+                gt = np.sort(rng.random((n_g, 2, 2)) * 150, axis=1).reshape(n_g, 4)
+                det = np.sort(rng.random((n_d, 2, 2)) * 150, axis=1).reshape(n_d, 4)
+                if n_g and n_d:
+                    k = min(n_g, n_d)
+                    det[:k] = gt[:k] + rng.normal(scale=5, size=(k, 4))
+                preds.append(dict(boxes=det, scores=np.round(rng.random(n_d), 1),
+                                  labels=rng.integers(0, n_cls, n_d)))
+                targets.append(dict(boxes=gt, labels=rng.integers(0, n_cls, n_g)))
+            return preds, targets
+
+        param_sets = [
+            {},
+            {"iou_thresholds": [0.3]},
+            {"iou_thresholds": [0.25, 0.9], "rec_thresholds": [0.0, 0.5, 1.0]},
+            {"max_detection_thresholds": [2, 5]},
+            {"max_detection_thresholds": [1]},
+            {"iou_thresholds": [0.5, 0.75], "max_detection_thresholds": [3], "class_metrics": True},
+        ]
+        for params in param_sets:
+            preds, targets = workload(12, 4)
+
+            def run():
+                m = MeanAveragePrecision(**params)
+                m.update(preds, targets)
+                return {k: np.asarray(v) for k, v in m.compute().items()}
+
+            native = run()
+            saved = native_mod._LIB
+            try:
+                native_mod._LIB = None
+                fallback = run()
+            finally:
+                native_mod._LIB = saved
+            for key in native:
+                np.testing.assert_allclose(
+                    native[key], fallback[key], atol=1e-9, err_msg=f"{params} {key}"
+                )
