@@ -348,10 +348,7 @@ def _bench_text(n_batches=128, sentences_per_batch=32):
         model = FlaxBertModel(cfg, seed=0, dtype=jnp.bfloat16)
     # commit the weights to the accelerator (a CPU-committed params tree would
     # either fail device colocation under jit or drag the forward to CPU)
-    model.params = jax.device_put(
-        jax.tree_util.tree_map(lambda v: v.astype(jnp.bfloat16), model.params),
-        jax.devices()[0],
-    )
+    model.params = jax.device_put(model.to_bf16(model.params), jax.devices()[0])
 
     # host-side tokenization cost alone (the reference pays this in update,
     # text/bert.py:175-203)

@@ -9,6 +9,24 @@ import pytest
 
 from metrics_tpu.detection import MeanAveragePrecision
 from metrics_tpu.detection.mean_ap import box_convert, box_iou
+from contextlib import contextmanager
+
+
+@contextmanager
+def _force_python_fallback():
+    """Temporarily hide the native library so every kernel takes its
+    pure-python fallback (native_available() has already set _TRIED)."""
+    import metrics_tpu._native as native_mod
+
+    if not native_mod.native_available():
+        pytest.skip("native library unavailable")
+    saved = native_mod._LIB
+    native_mod._LIB = None
+    try:
+        yield
+    finally:
+        native_mod._LIB = saved
+
 
 PREDS = [
     [
@@ -586,12 +604,8 @@ class TestRound4NativeKernels:
             return {k: np.asarray(v) for k, v in m.compute().items()}
 
         with_native = run()
-        saved = native_mod._LIB
-        try:
-            native_mod._LIB = None
+        with _force_python_fallback():
             without_native = run()
-        finally:
-            native_mod._LIB = saved
         for key in with_native:
             np.testing.assert_allclose(
                 with_native[key], without_native[key], atol=1e-9, err_msg=key
@@ -650,13 +664,45 @@ class TestRound4NativeKernels:
                 return {k: np.asarray(v) for k, v in m.compute().items()}
 
             native = run()
-            saved = native_mod._LIB
-            try:
-                native_mod._LIB = None
+            with _force_python_fallback():
                 fallback = run()
-            finally:
-                native_mod._LIB = saved
             for key in native:
                 np.testing.assert_allclose(
                     native[key], fallback[key], atol=1e-9, err_msg=f"{params} {key}"
                 )
+
+    def test_segm_pipeline_native_vs_python_fallback(self):
+        import metrics_tpu._native as native_mod
+
+        if not native_mod.native_available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(5)
+        yy, xx = np.mgrid[0:48, 0:64]
+
+        def blobs(n):
+            cy = rng.integers(8, 40, n)
+            cx = rng.integers(8, 56, n)
+            r = rng.integers(4, 14, n)
+            return np.stack(
+                [((yy - cy[i]) ** 2 + (xx - cx[i]) ** 2) < r[i] ** 2 for i in range(n)]
+            ).astype(np.uint8)
+
+        preds, targets = [], []
+        for _ in range(5):
+            g = blobs(3)
+            d = np.concatenate([g, blobs(2)])
+            lg = rng.integers(0, 3, 3)
+            preds.append(dict(masks=d, scores=rng.random(5),
+                              labels=np.concatenate([lg, rng.integers(0, 3, 2)])))
+            targets.append(dict(masks=g, labels=lg))
+
+        def run():
+            m = MeanAveragePrecision(iou_type="segm")
+            m.update(preds, targets)
+            return {k: np.asarray(v) for k, v in m.compute().items()}
+
+        native = run()
+        with _force_python_fallback():
+            fallback = run()
+        for key in native:
+            np.testing.assert_allclose(native[key], fallback[key], atol=1e-9, err_msg=key)

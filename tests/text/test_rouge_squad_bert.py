@@ -345,3 +345,15 @@ class TestHostAccumulation:
         sq.update(squad_p, squad_t)
         out = sq.compute()
         assert float(out["exact_match"]) == 100.0
+
+    def test_compositional_algebra_over_host_accumulating_metrics(self):
+        import numpy as np
+
+        from metrics_tpu import CharErrorRate, WordErrorRate
+
+        w, c = WordErrorRate(), CharErrorRate()
+        combo = w + c  # CompositionalMetric reads both computes lazily
+        w.update(["a b"], ["a c"])
+        c.update(["a b"], ["a c"])
+        want = float(w.compute()) + float(c.compute())
+        np.testing.assert_allclose(float(combo.compute()), want, atol=1e-6)
