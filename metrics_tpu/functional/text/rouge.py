@@ -109,14 +109,32 @@ def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> D
     pred_len, target_len = sum(p.values()), sum(t.values())
     if 0 in (pred_len, target_len):
         return dict(precision=0.0, recall=0.0, fmeasure=0.0)
-    hits = sum((p & t).values())
+    # clipped overlap without materializing the Counter intersection
+    if len(t) < len(p):
+        p, t = t, p
+    hits = sum(c if c <= t[k] else t[k] for k, c in p.items() if k in t)
     return _stat_triple(hits, pred_len, target_len)
+
+
+def _lcs_length(pred: Sequence[str], target: Sequence[str]) -> int:
+    """LCS length only — two rolling rows instead of the full table."""
+    prev = [0] * (len(pred) + 1)
+    cur = [0] * (len(pred) + 1)
+    for ti in target:
+        for j in range(1, len(pred) + 1):
+            if ti == pred[j - 1]:
+                cur[j] = prev[j - 1] + 1
+            else:
+                a, b = prev[j], cur[j - 1]
+                cur[j] = a if a >= b else b
+        prev, cur = cur, prev
+    return prev[len(pred)]
 
 
 def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, float]:
     if 0 in (len(pred), len(target)):
         return dict(precision=0.0, recall=0.0, fmeasure=0.0)
-    lcs = _lcs_table(pred, target)[-1][-1]
+    lcs = _lcs_length(pred, target)
     return _stat_triple(lcs, len(pred), len(target))
 
 
@@ -163,12 +181,13 @@ def _rouge_score_update(
     totals: Dict[Union[int, str], Dict[str, List[float]]] = {
         k: {"precision": [], "recall": [], "fmeasure": []} for k in rouge_keys_values
     }
+    need_lsum = "Lsum" in rouge_keys_values
     for pred_raw, refs in zip(preds, target):
         pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
         pred_lsum = [
             _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer)
             for s in _split_sentence(pred_raw)
-        ]
+        ] if need_lsum else []
         per_ref: List[Dict[Union[int, str], Dict[str, float]]] = []
         for ref_raw in refs:
             tgt = _normalize_and_tokenize_text(ref_raw, stemmer, normalizer, tokenizer)

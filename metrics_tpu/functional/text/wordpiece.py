@@ -85,6 +85,11 @@ class WordPieceTokenizer:
         self.unk_token_id = self.vocab[_UNK]
         self.cls_token_id = self.vocab[_CLS]
         self.sep_token_id = self.vocab[_SEP]
+        # word-level memoization (HF fast tokenizers cache the same way):
+        # natural text is zipfian, so the normalize + greedy-match work per
+        # DISTINCT word amortizes to a dict hit per occurrence
+        self._word_ids_cache: Dict[str, List[int]] = {}
+        self._cache_cap = 1 << 18  # bound memory on adversarial streams
 
     # ------------------------------------------------------ basic tokenizer
     def _clean(self, text: str) -> str:
@@ -96,34 +101,47 @@ class WordPieceTokenizer:
             out.append(" " if ch.isspace() else ch)
         return "".join(out)
 
-    def basic_tokenize(self, text: str) -> List[str]:
+    def _split_words(self, text: str) -> List[str]:
+        """Whitespace/CJK pre-split (the per-word normalization is cached)."""
+        if text.isascii():
+            # printable ascii needs no cleanup (split() absorbs whitespace
+            # runs) and cannot contain CJK — skip the per-char scans
+            if not text.isprintable():
+                text = self._clean(text)
+            return text.split()
         text = self._clean(text)
         # CJK characters become standalone tokens (BERT convention)
-        spaced = []
-        for ch in text:
-            if _is_cjk(ord(ch)):
-                spaced.append(f" {ch} ")
-            else:
-                spaced.append(ch)
-        words = "".join(spaced).split()
+        if any(_is_cjk(ord(ch)) for ch in text):
+            spaced = []
+            for ch in text:
+                spaced.append(f" {ch} " if _is_cjk(ord(ch)) else ch)
+            text = "".join(spaced)
+        return text.split()
+
+    def _normalize_word(self, word: str) -> List[str]:
+        if self.do_lower_case:
+            word = word.lower()
+            word = unicodedata.normalize("NFD", word)
+            word = "".join(ch for ch in word if unicodedata.category(ch) != "Mn")
+        # split punctuation into standalone tokens
         out: List[str] = []
-        for word in words:
-            if self.do_lower_case:
-                word = word.lower()
-                word = unicodedata.normalize("NFD", word)
-                word = "".join(ch for ch in word if unicodedata.category(ch) != "Mn")
-            # split punctuation into standalone tokens
-            cur: List[str] = []
-            for ch in word:
-                if _is_punctuation(ch):
-                    if cur:
-                        out.append("".join(cur))
-                        cur = []
-                    out.append(ch)
-                else:
-                    cur.append(ch)
-            if cur:
-                out.append("".join(cur))
+        cur: List[str] = []
+        for ch in word:
+            if _is_punctuation(ch):
+                if cur:
+                    out.append("".join(cur))
+                    cur = []
+                out.append(ch)
+            else:
+                cur.append(ch)
+        if cur:
+            out.append("".join(cur))
+        return out
+
+    def basic_tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for word in self._split_words(text):
+            out.extend(self._normalize_word(word))
         return out
 
     # -------------------------------------------------------- wordpiece
@@ -156,6 +174,26 @@ class WordPieceTokenizer:
             out.extend(self.wordpiece(word))
         return out
 
+    def _word_to_ids(self, raw_word: str) -> List[int]:
+        """normalize + wordpiece + ids for one raw word, memoized."""
+        cached = self._word_ids_cache.get(raw_word)
+        if cached is None:
+            ids: List[int] = []
+            for sub in self._normalize_word(raw_word):
+                for piece in self.wordpiece(sub):
+                    ids.append(self.vocab.get(piece, self.unk_token_id))
+            if len(self._word_ids_cache) >= self._cache_cap:
+                self._word_ids_cache.clear()
+            self._word_ids_cache[raw_word] = cached = ids
+        return cached
+
+    def text_to_ids(self, text: str) -> List[int]:
+        """Token ids for a text (no specials), via the per-word cache."""
+        ids: List[int] = []
+        for word in self._split_words(text):
+            ids.extend(self._word_to_ids(word))
+        return ids
+
     # ----------------------------------------------------- HF call surface
     def convert_tokens_to_ids(self, tokens: Sequence[str]) -> List[int]:
         return [self.vocab.get(t, self.unk_token_id) for t in tokens]
@@ -170,7 +208,7 @@ class WordPieceTokenizer:
     ) -> Dict[str, List[List[int]]]:
         ids_batch, mask_batch = [], []
         for text in texts:
-            ids = self.convert_tokens_to_ids(self.tokenize(text))
+            ids = self.text_to_ids(text)
             if truncation:
                 ids = ids[: max_length - 2]
             ids = [self.cls_token_id] + ids + [self.sep_token_id]

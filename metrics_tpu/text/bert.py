@@ -126,7 +126,11 @@ class BERTScore(Metric):
             if self.baseline_values is None:
                 raise ValueError("`rescale_with_baseline` needs `baseline_values` in offline builds.")
             out = {k: (v - self.baseline_values[k]) / (1.0 - self.baseline_values[k]) for k, v in out.items()}
-        result = {k: np.asarray(v).tolist() for k, v in out.items()}
+        # ONE stacked device->host fetch for all three outputs (per-key
+        # fetches pay one transfer round trip each over a remote device)
+        keys = list(out)
+        stacked = np.asarray(jnp.stack([jnp.asarray(out[k]) for k in keys]))
+        result = {k: stacked[i].tolist() for i, k in enumerate(keys)}
         if self.return_hash:
             result["hash"] = f"metrics_tpu-bert_score-{self.model_name_or_path or 'user-model'}"
         return result
