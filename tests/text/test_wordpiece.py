@@ -98,3 +98,32 @@ def test_drives_bertscore_end_to_end(vocab):
     metric.update(CORPUS[:2], CORPUS[:2])
     out = metric.compute()
     np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-4)
+
+
+def test_word_cache_parity_and_bounds():
+    """The per-word memoization must be invisible: cold and warm instances
+    agree, the cached path equals tokenize()+convert_tokens_to_ids, and the
+    cache cannot grow past its cap."""
+    import numpy as np
+
+    from metrics_tpu.functional.text.wordpiece import WordPieceTokenizer, build_wordpiece_vocab
+
+    rng = np.random.default_rng(17)
+    words = ["alpha", "beta", "Gamma!", "café", "naïve", "x" * 120, "你好"]
+    texts = [" ".join(rng.choice(words, size=6)) for _ in range(200)]
+    vocab = build_wordpiece_vocab(texts, size=400)
+    warm = WordPieceTokenizer(vocab)
+    warm(texts, padding="max_length", max_length=16)  # populate the cache
+    cold = WordPieceTokenizer(vocab)
+    assert warm(texts, padding="max_length", max_length=16) == cold(
+        texts, padding="max_length", max_length=16
+    )
+    for t in texts[:40]:
+        assert warm.text_to_ids(t) == warm.convert_tokens_to_ids(warm.tokenize(t))
+    # cap: force eviction and keep working
+    tiny = WordPieceTokenizer(vocab)
+    tiny._cache_cap = 4
+    for t in texts:
+        tiny.text_to_ids(t)
+    assert len(tiny._word_ids_cache) <= tiny._cache_cap
+    assert tiny.text_to_ids(texts[0]) == cold.text_to_ids(texts[0])
