@@ -1009,16 +1009,79 @@ class Metric(ABC):
                     return
             for bname, rows in buffer_rows.items():
                 self._ensure_buffer_capacity(bname, rows * (n - skip))
+        # A lax.scan fold is sequential: every step pays loop overhead far
+        # above the per-batch math for small state.  When the merge identity
+        # the forward fast path already relies on holds
+        # (full_state_update=False) and every state reduces associatively
+        # (sum/max/min tensor states, no buffers), the fold runs instead as
+        # ONE parallel program: vmap the update from the default state over
+        # the stack, reduce the per-batch states across the batch axis, and
+        # fold the result into the live state.  The per-batch state stack is
+        # capped so a huge state (e.g. a large confusion matrix) keeps the
+        # scan.
+        state_bytes = sum(
+            int(np.prod(v.shape)) * v.dtype.itemsize
+            for v in self._state.values()
+            if hasattr(v, "shape") and hasattr(v, "dtype")
+        )
+        can_vmap = (
+            self.full_state_update is False
+            and not self._buffer_states
+            and not any(isinstance(v, list) for v in self._state.values())
+            and bool(self._reduce_fns)
+            and all(fx in ("sum", "max", "min") for fx in self._reduce_fns.values())
+            and state_bytes <= (8 << 20)  # large states keep the scan
+            and state_bytes * (n - skip) <= (256 << 20)
+        )
         try:
-            statics_key = (treedef, statics)
+            statics_key = (treedef, statics, can_vmap)
             hash(statics_key)
         except TypeError:
             _loop_fallback(start=skip)
             return
         if self._jitted_update_batched is None:
             self._jitted_update_batched = {}
-        fused = self._jitted_update_batched.get(statics_key)
-        if fused is None:
+
+        def _build_vmap_variant() -> Callable:
+            # default_state enters as a jit ARGUMENT: a closure-captured
+            # pytree would lower as embedded HLO constants
+            def pure_update_many(
+                state: Dict[str, Any], arr_stack: tuple, default_state: Dict[str, Any]
+            ) -> Dict[str, Any]:
+                # trace-time static stream length, read off the stack
+                n_eff = jax.tree_util.tree_leaves(arr_stack)[0].shape[0]
+
+                def one_slice(sl: tuple) -> Dict[str, Any]:
+                    it = iter(sl)
+                    leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
+                    sl_args, sl_kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+                    _, new = self._run_with_state(
+                        dict(default_state), self._update_impl, sl_args, sl_kwargs
+                    )
+                    return new
+
+                stacked = jax.vmap(one_slice)(arr_stack)
+                out: Dict[str, Any] = {}
+                for name, live in state.items():
+                    fx = self._reduce_fns[name]
+                    s = stacked[name]
+                    if fx == "sum":
+                        # the live state already carries its own default and
+                        # every lane starts from one more copy: subtract all
+                        # n_eff extras so the result equals the per-batch loop
+                        out[name] = (
+                            live + jnp.sum(s, axis=0)
+                            - n_eff * jnp.asarray(default_state[name], s.dtype)
+                        )
+                    elif fx == "max":
+                        out[name] = jnp.maximum(live, jnp.max(s, axis=0))
+                    else:  # min
+                        out[name] = jnp.minimum(live, jnp.min(s, axis=0))
+                return out
+
+            return pure_update_many
+
+        def _build_scan_variant() -> Callable:
             def pure_update_many(state: Dict[str, Any], arr_stack: tuple) -> Dict[str, Any]:
                 def body(st: Dict[str, Any], sl: tuple) -> tuple:
                     it = iter(sl)
@@ -1030,25 +1093,60 @@ class Metric(ABC):
                 new_state, _ = jax.lax.scan(body, state, arr_stack)
                 return new_state
 
-            donate = (0,) if self.donate_state else ()
-            fused = jax.jit(pure_update_many, donate_argnums=donate)
-            self._jitted_update_batched[statics_key] = fused
+            return pure_update_many
+
+        donate = (0,) if self.donate_state else ()
         arr_stack = tuple(x[skip:] if skip else x for x, b in zip(all_leaves, is_batched) if b)
-        try:
-            with _quiet_donation():
-                new_state = fused(self._state, arr_stack)
-        except (
+        # trace-time failures mean nothing executed (donated buffers intact),
+        # so falling back is safe; runtime failures (device OOM, ...)
+        # propagate — after donation the state may be consumed, and a silent
+        # fallback would corrupt it.  The vmap attempt additionally treats
+        # ValueError as a trace failure: a vmapped body may fail to LOWER
+        # (e.g. a pallas kernel whose block spec rejects the added batch dim).
+        trace_failures = (
             TypeError,  # scan carry structure/dtype mismatch
             jax.errors.ConcretizationTypeError,
             jax.errors.TracerArrayConversionError,
             jax.errors.TracerIntegerConversionError,
             jax.errors.NonConcreteBooleanIndexError,
-        ):
-            # trace-time failure: nothing executed (donated buffers intact);
-            # the eager loop either succeeds or surfaces the real error.
-            # Runtime failures (device OOM, ...) propagate — after donation
-            # the state may be consumed, so a silent fallback would corrupt it
-            self._jitted_update_batched.pop(statics_key, None)
+        )
+
+        def _get_or_build(key, builder, is_vmap):
+            entry = self._jitted_update_batched.get(key)
+            if entry is None:
+                entry = (jax.jit(builder(), donate_argnums=donate), is_vmap)
+                self._jitted_update_batched[key] = entry
+            return entry
+
+        def _dispatch(entry, catch: tuple):
+            fn, is_vmap = entry
+            extra = (self.init_state(),) if is_vmap else ()
+            try:
+                with _quiet_donation():
+                    return fn(self._state, arr_stack, *extra)
+            except catch:
+                return None
+
+        new_state = None
+        if can_vmap:
+            entry = _get_or_build(statics_key, _build_vmap_variant, True)
+            catch = trace_failures + ((ValueError,) if entry[1] else ())
+            new_state = _dispatch(entry, catch)
+            if new_state is None:  # drop to the scan variant, key it for reuse
+                self._jitted_update_batched.pop(statics_key, None)
+                scan_key = (treedef, statics, False)
+                entry = _get_or_build(scan_key, _build_scan_variant, False)
+                self._jitted_update_batched[statics_key] = entry
+                new_state = _dispatch(entry, trace_failures)
+                if new_state is None:
+                    self._jitted_update_batched.pop(statics_key, None)
+                    self._jitted_update_batched.pop(scan_key, None)
+        else:
+            entry = _get_or_build(statics_key, _build_scan_variant, False)
+            new_state = _dispatch(entry, trace_failures)
+            if new_state is None:
+                self._jitted_update_batched.pop(statics_key, None)
+        if new_state is None:
             _loop_fallback(start=skip)
             return
         self._state.update(new_state)

@@ -331,3 +331,96 @@ class TestBufferedCurveStates:
             m.forward(p, t)
         assert m._state["preds__len"] == 24
         m.compute()
+
+
+class TestVmapUpdateBatched:
+    """The round-4 update_batched fast path: when every state reduces
+    associatively (sum/max/min, full_state_update=False, no buffers), the
+    stream folds as ONE vmap + cross-batch reduction instead of a
+    sequential lax.scan.  Results must be identical to the per-batch loop,
+    including on a non-empty live state."""
+
+    def test_vmap_path_equals_loop(self):
+        from metrics_tpu import Accuracy, MaxMetric, MeanSquaredError, MinMetric
+
+        rng = np.random.default_rng(7)
+        preds = jnp.asarray(rng.random((20, 64, 4), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 4, (20, 64)))
+        fused = Accuracy(num_classes=4, validate_args=False)
+        fused.update_batched(preds, target)
+        looped = Accuracy(num_classes=4, validate_args=False, lazy_updates=0)
+        for i in range(20):
+            looped.update(preds[i], target[i])
+        assert abs(float(fused.compute()) - float(looped.compute())) < 1e-6
+
+        partial = Accuracy(num_classes=4, validate_args=False)
+        partial.update(preds[0], target[0])  # non-empty live state first
+        partial.update_batched(preds[1:], target[1:])
+        assert abs(float(partial.compute()) - float(looped.compute())) < 1e-6
+
+        vec = preds[:, :, 0]
+        m = MeanSquaredError()
+        m.update_batched(vec, jnp.zeros((20, 64)))
+        m_ref = MeanSquaredError(lazy_updates=0)
+        for i in range(20):
+            m_ref.update(vec[i], jnp.zeros(64))
+        assert abs(float(m.compute()) - float(m_ref.compute())) < 1e-6
+
+        # aggregators route through the eager loop (full_state_update=True);
+        # still a correctness check on the public surface
+        mx, mn = MaxMetric(), MinMetric()
+        mx.update_batched(vec)
+        mn.update_batched(vec)
+        assert float(mx.compute()) == float(vec.max())
+        assert float(mn.compute()) == float(vec.min())
+
+    def test_vmap_variant_selected_and_all_reduce_branches_exact(self):
+        """A jittable sum/max/min-state metric must take the vmap variant
+        (asserted via the cache entry) and agree with the loop on every
+        branch — including a NONZERO sum default, which the merge must
+        correct for (each vmap lane starts from one extra default copy)."""
+        from metrics_tpu.metric import Metric
+
+        class Stats(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                # nonzero default pins the n_eff-defaults correction
+                self.add_state("total", default=jnp.asarray(5.0), dist_reduce_fx="sum")
+                self.add_state("hi", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+                self.add_state("lo", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
+
+            def update(self, x):
+                self.total = self.total + jnp.sum(x)
+                self.hi = jnp.maximum(self.hi, jnp.max(x))
+                self.lo = jnp.minimum(self.lo, jnp.min(x))
+
+            def compute(self):
+                return self.total, self.hi, self.lo
+
+        stack = jnp.asarray(_rng.random((12, 32), dtype=np.float32))
+        fused = Stats()
+        fused.update_batched(stack)
+        assert any(
+            entry[1] for entry in fused._jitted_update_batched.values()
+        ), "the vmap variant was not selected for an eligible metric"
+        looped = Stats()
+        looped.lazy_updates = 0
+        for i in range(12):
+            looped.update(stack[i])
+        for got, want in zip(fused.compute(), looped.compute()):
+            np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    def test_scan_kept_for_buffer_and_cat_states(self):
+        from metrics_tpu.classification import PrecisionRecallCurve
+
+        rng = np.random.default_rng(8)
+        stacked_p = jnp.asarray(rng.random((6, 16), dtype=np.float32))
+        stacked_t = jnp.asarray(rng.integers(0, 2, (6, 16)))
+        fused, looped = PrecisionRecallCurve(), PrecisionRecallCurve(lazy_updates=0)
+        fused.update_batched(stacked_p, stacked_t)
+        for i in range(6):
+            looped.update(stacked_p[i], stacked_t[i])
+        for a, b in zip(fused.compute(), looped.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

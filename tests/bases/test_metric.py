@@ -289,7 +289,7 @@ class TestUpdateBatched:
             m.update_batched(jnp.ones((5, 3)))
         assert m._jitted_update_batched is not None
         assert len(m._jitted_update_batched) == 1  # one static signature
-        (fused,) = m._jitted_update_batched.values()
+        ((fused, _is_vmap),) = m._jitted_update_batched.values()
         assert fused._cache_size() == 1
         assert m.update_count == 20
 
