@@ -420,6 +420,9 @@ class TestVmapUpdateBatched:
         stacked_t = jnp.asarray(rng.integers(0, 2, (6, 16)))
         fused, looped = PrecisionRecallCurve(), PrecisionRecallCurve(lazy_updates=0)
         fused.update_batched(stacked_p, stacked_t)
+        assert all(
+            not entry[1] for entry in fused._jitted_update_batched.values()
+        ), "buffer-state metric must take the scan variant, not vmap"
         for i in range(6):
             looped.update(stacked_p[i], stacked_t[i])
         for a, b in zip(fused.compute(), looped.compute()):

@@ -1,0 +1,468 @@
+"""Exhaustive StatScores-family sweep: regime x reduction product vs sklearn.
+
+The reference sweeps every input regime against sklearn-built oracles for the
+counting core and each derived score
+(``tests/unittests/classification/test_stat_scores.py``,
+``test_precision_recall.py``: regime x average x mdmc parametrizations); this
+file is the same product for the TPU framework.  Inputs are drawn so that
+every class has support AND at least one prediction (asserted below) — on such
+data the reference's macro drop-rule (classes with tp+fp+fn==0 are removed
+from the mean, ``functional/classification/precision_recall.py:55-58``) never
+fires, so plain sklearn is an exact oracle.  The zero-support edge is pinned
+separately in :class:`TestAbsentClassEdges`.
+"""
+
+import numpy as np
+import pytest
+import sklearn.metrics as sk
+from sklearn.metrics import multilabel_confusion_matrix
+
+import metrics_tpu.functional as F
+from metrics_tpu import (
+    Accuracy,
+    F1Score,
+    FBetaScore,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_logits_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_logits_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+# ----------------------------------------------------------------- oracles
+
+
+def _canonical(preds, target, regime):
+    """Numpy mirror of the canonical one-hot form the counting core consumes.
+
+    Mirrors reference ``_input_format_classification`` outputs: binary ->
+    ``(N, 1)``, multilabel/multiclass -> ``(N, C)``, multidim multiclass ->
+    ``(N, C, X)`` (reference ``functional/classification/stat_scores.py:64-92``
+    documents the consumed shapes).
+    """
+    preds, target = np.asarray(preds), np.asarray(target)
+    if regime == "binary_prob":
+        return (preds >= THRESHOLD).astype(int)[:, None], target[:, None]
+    if regime == "binary_labels":
+        return preds[:, None], target[:, None]
+    if regime in ("multilabel_prob", "multilabel_logits"):
+        # the reference thresholds RAW values — no sigmoid; a logits user
+        # passes threshold=0 (reference ``utilities/checks.py:421``)
+        return (preds >= THRESHOLD).astype(int), target
+    if regime == "multilabel_labels":
+        return preds, target
+    eye = np.eye(NUM_CLASSES, dtype=int)
+    if regime in ("multiclass_prob", "multiclass_logits"):
+        return eye[preds.argmax(-1)], eye[target]
+    if regime == "multiclass_labels":
+        return eye[preds], eye[target]
+    if regime == "mdmc_prob":  # preds (N, C, X), target (N, X)
+        p1h = np.moveaxis(eye[preds.argmax(1)], -1, 1)  # (N, X, C) -> (N, C, X)
+        t1h = np.moveaxis(eye[target], -1, 1)
+        return p1h, t1h
+    if regime == "mdmc_labels":
+        p1h = np.moveaxis(eye[preds], -1, 1)
+        t1h = np.moveaxis(eye[target], -1, 1)
+        return p1h, t1h
+    raise ValueError(regime)
+
+
+def _np_counts(p1h, t1h, reduce):
+    """tp/fp/tn/fn with the reference's reduce-dependent shape contract."""
+    if reduce == "micro":
+        dims = (0, 1) if p1h.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dims = (0,) if p1h.ndim == 2 else (2,)
+    else:  # samples
+        dims = (1,)
+    tp = ((p1h == 1) & (t1h == 1)).sum(axis=dims)
+    fp = ((p1h == 1) & (t1h == 0)).sum(axis=dims)
+    tn = ((p1h == 0) & (t1h == 0)).sum(axis=dims)
+    fn = ((p1h == 0) & (t1h == 1)).sum(axis=dims)
+    return tp, fp, tn, fn
+
+
+def _sk_stat_scores(preds, target, regime, reduce, mdmc_reduce=None):
+    p1h, t1h = _canonical(preds, target, regime)
+    if p1h.ndim == 3 and mdmc_reduce == "global":
+        p1h = np.moveaxis(p1h, 1, 2).reshape(-1, p1h.shape[1])
+        t1h = np.moveaxis(t1h, 1, 2).reshape(-1, t1h.shape[1])
+    tp, fp, tn, fn = _np_counts(p1h, t1h, reduce)
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+
+
+def _flatten_mdmc(preds, target, regime):
+    """(N, C, X)/(N, X) -> label vectors for sklearn (global averaging)."""
+    preds, target = np.asarray(preds), np.asarray(target)
+    if regime == "mdmc_prob":
+        preds = preds.argmax(1)
+    return preds.reshape(-1), target.reshape(-1)
+
+
+def _to_labels(preds, target, regime):
+    """Label/indicator form sklearn score functions consume."""
+    preds, target = np.asarray(preds), np.asarray(target)
+    if regime == "binary_prob":
+        return (preds >= THRESHOLD).astype(int), target
+    if regime in ("multiclass_prob", "multiclass_logits"):
+        return preds.argmax(-1), target
+    if regime in ("multilabel_prob", "multilabel_logits"):
+        return (preds >= THRESHOLD).astype(int), target
+    return preds, target  # already labels / indicators
+
+
+_SK_AVG = {"micro": "micro", "macro": "macro", "weighted": "weighted", "none": None}
+
+
+def _sk_prf(preds, target, regime, metric, average, beta=1.0):
+    """sklearn oracle for precision/recall/fbeta over any label regime."""
+    p, t = _to_labels(preds, target, regime)
+    if regime.startswith("binary"):
+        kw = {"average": "binary"}
+    elif regime.startswith("multilabel"):
+        kw = {"average": _SK_AVG[average], "zero_division": 0}
+    else:
+        kw = {"average": _SK_AVG[average], "labels": list(range(NUM_CLASSES)), "zero_division": 0}
+    if metric == "precision":
+        return sk.precision_score(t, p, **kw)
+    if metric == "recall":
+        return sk.recall_score(t, p, **kw)
+    return sk.fbeta_score(t, p, beta=beta, **kw)
+
+
+def _sk_specificity(preds, target, regime, average):
+    """tn / (tn + fp) from sklearn's per-class confusion matrices."""
+    p1h, t1h = _canonical(preds, target, regime)
+    mcm = multilabel_confusion_matrix(t1h, p1h)
+    tn, fp = mcm[:, 0, 0], mcm[:, 0, 1]
+    if average == "micro":
+        return tn.sum() / (tn.sum() + fp.sum())
+    per_class = np.where(tn + fp == 0, 0.0, tn / np.maximum(tn + fp, 1))
+    if average == "macro":
+        return per_class.mean()
+    if average == "weighted":
+        w = tn + fp
+        return (per_class * w).sum() / w.sum()
+    return per_class  # none
+
+
+def _assert_all_classes_live(p1h, t1h):
+    """The sweep's oracle-validity precondition (see module docstring)."""
+    if p1h.ndim == 3:
+        p1h = np.moveaxis(p1h, 1, 2).reshape(-1, p1h.shape[1])
+        t1h = np.moveaxis(t1h, 1, 2).reshape(-1, t1h.shape[1])
+    assert (t1h.sum(0) > 0).all(), "a class has no support — oracle invalid"
+    assert (p1h.sum(0) > 0).all(), "a class is never predicted — oracle invalid"
+
+
+_FLAT_REGIMES = [
+    ("binary_prob", _binary_prob_inputs, {}),
+    ("binary_labels", _binary_inputs, {}),
+    ("multilabel_prob", _multilabel_prob_inputs, {"num_classes": NUM_CLASSES}),
+    ("multilabel_logits", _multilabel_logits_inputs, {"num_classes": NUM_CLASSES}),
+    ("multilabel_labels", _multilabel_inputs, {"num_classes": NUM_CLASSES}),
+    ("multiclass_prob", _multiclass_prob_inputs, {"num_classes": NUM_CLASSES}),
+    ("multiclass_logits", _multiclass_logits_inputs, {"num_classes": NUM_CLASSES}),
+    ("multiclass_labels", _multiclass_inputs, {"num_classes": NUM_CLASSES}),
+]
+
+_MDMC_REGIMES = [
+    ("mdmc_prob", _multidim_multiclass_prob_inputs, {"num_classes": NUM_CLASSES}),
+    ("mdmc_labels", _multidim_multiclass_inputs, {"num_classes": NUM_CLASSES}),
+]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _validate_input_banks():
+    for regime, inputs, _ in _FLAT_REGIMES + _MDMC_REGIMES:
+        if regime.startswith("binary"):
+            continue
+        for i in range(len(inputs.preds)):
+            _assert_all_classes_live(*_canonical(inputs.preds[i], inputs.target[i], regime))
+
+
+def test_np_counts_anchor_vs_sklearn():
+    """The hand-rolled count oracle itself is anchored on sklearn's mcm."""
+    p1h, t1h = _canonical(
+        _multiclass_prob_inputs.preds[0], _multiclass_prob_inputs.target[0], "multiclass_prob"
+    )
+    mcm = multilabel_confusion_matrix(t1h, p1h)
+    tp, fp, tn, fn = _np_counts(p1h, t1h, "macro")
+    np.testing.assert_array_equal(tp, mcm[:, 1, 1])
+    np.testing.assert_array_equal(fp, mcm[:, 0, 1])
+    np.testing.assert_array_equal(tn, mcm[:, 0, 0])
+    np.testing.assert_array_equal(fn, mcm[:, 1, 0])
+
+
+class TestStatScoresSweep(MetricTester):
+    """The counting core across every flat regime x reduce."""
+
+    @pytest.mark.parametrize("reduce", ["micro", "macro", "samples"])
+    @pytest.mark.parametrize(
+        "regime,inputs,args", _FLAT_REGIMES, ids=[r[0] for r in _FLAT_REGIMES]
+    )
+    def test_functional(self, regime, inputs, args, reduce):
+        if regime.startswith("binary") and reduce == "macro":
+            pytest.skip("binary canonical form has a single class column")
+        self.run_functional_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_functional=F.stat_scores,
+            reference_fn=lambda p, t: _sk_stat_scores(p, t, regime, reduce),
+            metric_args={"reduce": reduce, **args},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("reduce", ["micro", "macro"])
+    @pytest.mark.parametrize(
+        "regime,inputs,args",
+        [r for r in _FLAT_REGIMES if r[0] in ("multiclass_prob", "multilabel_prob")],
+        ids=["multiclass_prob", "multilabel_prob"],
+    )
+    def test_class_streaming(self, regime, inputs, args, reduce, ddp):
+        self.run_class_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_class=StatScores,
+            reference_fn=lambda p, t: _sk_stat_scores(p, t, regime, reduce),
+            metric_args={"reduce": reduce, **args},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("mdmc_reduce", ["global", "samplewise"])
+    @pytest.mark.parametrize("reduce", ["micro", "macro", "samples"])
+    @pytest.mark.parametrize(
+        "regime,inputs,args", _MDMC_REGIMES, ids=[r[0] for r in _MDMC_REGIMES]
+    )
+    def test_mdmc_functional(self, regime, inputs, args, reduce, mdmc_reduce):
+        self.run_functional_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_functional=F.stat_scores,
+            reference_fn=lambda p, t: _sk_stat_scores(p, t, regime, reduce, mdmc_reduce),
+            metric_args={"reduce": reduce, "mdmc_reduce": mdmc_reduce, **args},
+        )
+
+
+_PRF_METRICS = [
+    ("precision", Precision, F.precision, {}),
+    ("recall", Recall, F.recall, {}),
+    ("f1", F1Score, F.f1_score, {}),
+    ("fbeta2", FBetaScore, F.fbeta_score, {"beta": 2.0}),
+]
+
+
+def _sk_metric_name(name):
+    """f1 and fbeta2 both map onto the sklearn fbeta oracle."""
+    return "fbeta" if name in ("f1", "fbeta2") else name
+
+
+class TestPRFSklearnSweep(MetricTester):
+    """precision/recall/f1/fbeta x average x regime, sklearn as oracle."""
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    @pytest.mark.parametrize(
+        "regime,inputs,args",
+        [r for r in _FLAT_REGIMES if not r[0].startswith("binary") and not r[0].endswith("labels")],
+        ids=["multilabel_prob", "multilabel_logits", "multiclass_prob", "multiclass_logits"],
+    )
+    @pytest.mark.parametrize("name,metric_class,functional,mkw", _PRF_METRICS, ids=[m[0] for m in _PRF_METRICS])
+    def test_flat(self, name, metric_class, functional, mkw, regime, inputs, args, average):
+        beta = mkw.get("beta", 1.0)
+        metric_name = _sk_metric_name(name)
+        self.run_functional_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_functional=functional,
+            reference_fn=lambda p, t: _sk_prf(p, t, regime, metric_name, average, beta=beta),
+            metric_args={"average": average, **mkw, **args},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    @pytest.mark.parametrize("name,metric_class,functional,mkw", _PRF_METRICS, ids=[m[0] for m in _PRF_METRICS])
+    def test_class_streaming_multiclass(self, name, metric_class, functional, mkw, average, ddp):
+        inputs = _multiclass_prob_inputs
+        beta = mkw.get("beta", 1.0)
+        metric_name = _sk_metric_name(name)
+        self.run_class_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_class=metric_class,
+            reference_fn=lambda p, t: _sk_prf(p, t, "multiclass_prob", metric_name, average, beta=beta),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, **mkw},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize("name,metric_class,functional,mkw", _PRF_METRICS[:3], ids=[m[0] for m in _PRF_METRICS[:3]])
+    def test_mdmc(self, name, metric_class, functional, mkw, average, mdmc_average):
+        inputs = _multidim_multiclass_prob_inputs
+        beta = mkw.get("beta", 1.0)
+        metric_name = _sk_metric_name(name)
+
+        def ref(p, t):
+            kw = {"average": _SK_AVG[average], "labels": list(range(NUM_CLASSES)), "zero_division": 0}
+            fn = {
+                "precision": sk.precision_score,
+                "recall": sk.recall_score,
+                "fbeta": lambda yt, yp, **k: sk.fbeta_score(yt, yp, beta=beta, **k),
+            }[metric_name]
+            if mdmc_average == "global":
+                pl, tl = _flatten_mdmc(p, t, "mdmc_prob")
+                return fn(tl, pl, **kw)
+            pl = np.asarray(p).argmax(1)  # (N, X)
+            tl = np.asarray(t)
+            return np.mean([fn(tl[i], pl[i], **kw) for i in range(len(pl))])
+
+        self.run_functional_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_functional=functional,
+            reference_fn=ref,
+            metric_args={
+                "average": average,
+                "mdmc_average": mdmc_average,
+                "num_classes": NUM_CLASSES,
+                **mkw,
+            },
+        )
+
+
+class TestSpecificitySweep(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    @pytest.mark.parametrize(
+        "regime,inputs,args",
+        [r for r in _FLAT_REGIMES if r[0] in ("multilabel_prob", "multiclass_prob", "multiclass_labels")],
+        ids=["multilabel_prob", "multiclass_prob", "multiclass_labels"],
+    )
+    def test_flat(self, regime, inputs, args, average):
+        self.run_functional_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_functional=F.specificity,
+            reference_fn=lambda p, t: _sk_specificity(p, t, regime, average),
+            metric_args={"average": average, **args},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_streaming(self, ddp):
+        inputs = _multiclass_prob_inputs
+        self.run_class_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_class=Specificity,
+            reference_fn=lambda p, t: _sk_specificity(p, t, "multiclass_prob", "macro"),
+            metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+            ddp=ddp,
+        )
+
+
+class TestIgnoreIndexSweep(MetricTester):
+    """ignore_index vs sklearn's labels-subset on every averaging mode.
+
+    Reference semantics (``functional/classification/stat_scores.py:180-194``):
+    for non-macro reductions the ignored class COLUMN is deleted after
+    one-hot-ification (samples whose target is ignored still contribute their
+    predictions to other columns), which is exactly sklearn's
+    ``labels=[c != ignored]`` micro behavior; for macro the class is dropped
+    from the averaged set.
+    """
+
+    @pytest.mark.parametrize("ignore_index", [0, 2, NUM_CLASSES - 1])
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize(
+        "name,functional",
+        [("precision", F.precision), ("recall", F.recall), ("f1", F.f1_score)],
+        ids=["precision", "recall", "f1"],
+    )
+    def test_multiclass(self, name, functional, average, ignore_index):
+        inputs = _multiclass_prob_inputs
+        labels = [c for c in range(NUM_CLASSES) if c != ignore_index]
+        fn = {"precision": sk.precision_score, "recall": sk.recall_score, "f1": sk.f1_score}[name]
+
+        def ref(p, t):
+            return fn(
+                t, np.asarray(p).argmax(-1),
+                average=_SK_AVG[average], labels=labels, zero_division=0,
+            )
+
+        self.run_functional_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_functional=functional,
+            reference_fn=ref,
+            metric_args={
+                "average": average,
+                "num_classes": NUM_CLASSES,
+                "ignore_index": ignore_index,
+            },
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_accuracy_ignore_index_streaming(self, average):
+        inputs = _multiclass_prob_inputs
+        labels = [c for c in range(NUM_CLASSES) if c != 1]
+
+        def ref(p, t):
+            # accuracy == recall-style tp/(tp+fn) for multiclass in the
+            # reference contract; micro over remaining columns
+            return sk.recall_score(
+                t, np.asarray(p).argmax(-1), average=_SK_AVG[average] or "macro",
+                labels=labels, zero_division=0,
+            )
+
+        self.run_class_metric_test(
+            inputs.preds,
+            inputs.target,
+            metric_class=Accuracy,
+            reference_fn=ref,
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "ignore_index": 1},
+        )
+
+
+class TestAbsentClassEdges(MetricTester):
+    """The zero-support edge the sweep's inputs deliberately avoid.
+
+    Pinned to the reference drop-rule: macro averaging removes classes with
+    tp+fp+fn == 0 from the mean; ``average='none'`` returns NaN for them
+    (``functional/classification/precision_recall.py:55-64``,
+    ``stat_scores.py:283-284``).
+    """
+
+    def test_macro_drops_absent_class(self):
+        # class 3 never appears in target nor preds (tp=fp=fn=0)
+        target = np.array([0, 1, 2, 0, 1, 2])
+        preds = np.array([0, 2, 1, 0, 1, 2])
+        got = F.precision(preds, target, average="macro", num_classes=4)
+        want = sk.precision_score(target, preds, average="macro", labels=[0, 1, 2], zero_division=0)
+        np.testing.assert_allclose(float(got), want, atol=1e-6)
+
+    def test_none_marks_absent_class_nan(self):
+        target = np.array([0, 1, 2, 0, 1, 2])
+        preds = np.array([0, 2, 1, 0, 1, 2])
+        got = np.asarray(F.recall(preds, target, average="none", num_classes=4))
+        present = sk.recall_score(target, preds, average=None, labels=[0, 1, 2], zero_division=0)
+        np.testing.assert_allclose(got[:3], present, atol=1e-6)
+        assert np.isnan(got[3])
+
+    def test_predicted_but_no_support_counts_in_macro(self):
+        # class 3 IS predicted (fp>0) so it stays in the macro mean with score 0
+        target = np.array([0, 1, 2, 0, 1, 2])
+        preds = np.array([0, 2, 1, 3, 1, 2])
+        got = F.precision(preds, target, average="macro", num_classes=4)
+        want = sk.precision_score(target, preds, average="macro", labels=[0, 1, 2, 3], zero_division=0)
+        np.testing.assert_allclose(float(got), want, atol=1e-6)

@@ -1,0 +1,235 @@
+"""Retrieval breadth sweep: k x metric x empty-action product, graded NDCG,
+per-metric ignore_index, and adversarial query layouts.
+
+The reference parametrizes every retrieval metric over ``k`` values, empty
+target behaviors and ignore_index through one shared helper layer
+(``tests/unittests/retrieval/helpers.py``); this file is that product for the
+segment-reduction engine, reusing the per-query numpy oracles from
+``test_retrieval.py``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from tests.retrieval.test_retrieval import (
+    N_QUERIES,
+    _make_inputs,
+    _np_ap,
+    _np_fall_out,
+    _np_hit_rate,
+    _np_mean_over_queries,
+    _np_ndcg,
+    _np_precision,
+    _np_recall,
+    _np_rr,
+)
+
+_K_METRICS = [
+    ("precision", RetrievalPrecision, _np_precision, "pos"),
+    ("recall", RetrievalRecall, _np_recall, "pos"),
+    ("fall_out", RetrievalFallOut, _np_fall_out, "neg"),
+    ("hit_rate", RetrievalHitRate, _np_hit_rate, "pos"),
+    ("ndcg", RetrievalNormalizedDCG, _np_ndcg, "pos"),
+]
+
+
+def _stream(metric, preds, target, indexes):
+    for p, t, i in zip(preds, target, indexes):
+        metric.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(i))
+    return float(metric.compute())
+
+
+class TestKSweep:
+    @pytest.mark.parametrize("k", [1, 2, 5, 16, None])
+    @pytest.mark.parametrize("name,cls,oracle,empty_on", _K_METRICS, ids=[m[0] for m in _K_METRICS])
+    def test_k_values(self, name, cls, oracle, empty_on, k):
+        preds, target, indexes = _make_inputs()
+        metric = cls(**({} if k is None else {"k": k}))
+        got = _stream(metric, preds, target, indexes)
+        want = _np_mean_over_queries(
+            preds.reshape(-1), target.reshape(-1), indexes.reshape(-1),
+            lambda p, t: oracle(p, t, k=k), empty_on=empty_on,
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("k", [0, -3])
+    @pytest.mark.parametrize("name,cls,oracle,empty_on", _K_METRICS, ids=[m[0] for m in _K_METRICS])
+    def test_invalid_k_raises(self, name, cls, oracle, empty_on, k):
+        with pytest.raises(ValueError):
+            cls(k=k)
+
+
+class TestEmptyActionTimesK:
+    """empty_target_action composes with k (the reference runs the full
+    product; the existing suite only covered the default k)."""
+
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    @pytest.mark.parametrize("k", [1, 3])
+    @pytest.mark.parametrize(
+        "name,cls,oracle,empty_on",
+        [m for m in _K_METRICS if m[0] != "fall_out"],
+        ids=[m[0] for m in _K_METRICS if m[0] != "fall_out"],
+    )
+    def test_product(self, name, cls, oracle, empty_on, k, action):
+        preds, target, indexes = _make_inputs(with_empty_query=True)
+        metric = cls(k=k, empty_target_action=action)
+        got = _stream(metric, preds, target, indexes)
+        want = _np_mean_over_queries(
+            preds.reshape(-1), target.reshape(-1), indexes.reshape(-1),
+            lambda p, t: oracle(p, t, k=k), empty_action=action, empty_on=empty_on,
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_fall_out_negative_empty(self, k, action):
+        """fall_out's empty case is a query with NO negatives (all targets 1),
+        the mirror image of the positive-empty fixture the other metrics use."""
+        preds, target, indexes = _make_inputs()
+        indexes[:, :3] = N_QUERIES  # dedicated query id...
+        target[:, :3] = 1  # ...with every target positive
+        metric = RetrievalFallOut(k=k, empty_target_action=action)
+        got = _stream(metric, preds, target, indexes)
+        want = _np_mean_over_queries(
+            preds.reshape(-1), target.reshape(-1), indexes.reshape(-1),
+            lambda p, t: _np_fall_out(p, t, k=k), empty_action=action, empty_on="neg",
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestGradedNDCG:
+    """NDCG is the one retrieval metric defined for graded (non-binary)
+    relevance; the engine must consume integer grades and float gains."""
+
+    @pytest.mark.parametrize("k", [None, 4])
+    def test_integer_grades(self, k):
+        preds, target, indexes = _make_inputs(graded=True)
+        metric = RetrievalNormalizedDCG(**({} if k is None else {"k": k}))
+        got = _stream(metric, preds, target, indexes)
+        want = _np_mean_over_queries(
+            preds.reshape(-1), target.reshape(-1), indexes.reshape(-1),
+            lambda p, t: _np_ndcg(p, t, k=k),
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_float_grades(self):
+        rng = np.random.default_rng(11)
+        preds = rng.random((2, 24)).astype(np.float32)
+        target = (rng.random((2, 24)) * 3.0).astype(np.float32)
+        indexes = rng.integers(0, 4, size=(2, 24))
+        metric = RetrievalNormalizedDCG()
+        got = _stream(metric, preds, target, indexes)
+        want = _np_mean_over_queries(
+            preds.reshape(-1), target.reshape(-1), indexes.reshape(-1), _np_ndcg,
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_binary_metric_rejects_graded_target(self):
+        metric = RetrievalMAP()
+        with pytest.raises(ValueError):
+            metric.update(
+                jnp.asarray([0.1, 0.2, 0.3]),
+                jnp.asarray([0, 2, 1]),
+                indexes=jnp.asarray([0, 0, 0]),
+            )
+
+
+class TestIgnoreIndexSweep:
+    """ignore_index drops rows before grouping, for EVERY metric — the
+    existing suite pinned it for one."""
+
+    @pytest.mark.parametrize(
+        "cls,args,oracle,empty_on",
+        [
+            (RetrievalMAP, {}, lambda p, t, k=None: _np_ap(p, t), "pos"),
+            (RetrievalMRR, {}, lambda p, t, k=None: _np_rr(p, t), "pos"),
+            (RetrievalPrecision, {"k": 3}, lambda p, t, k=3: _np_precision(p, t, k=3), "pos"),
+            (RetrievalRecall, {"k": 3}, lambda p, t, k=3: _np_recall(p, t, k=3), "pos"),
+            (RetrievalFallOut, {"k": 3}, lambda p, t, k=3: _np_fall_out(p, t, k=3), "neg"),
+            (RetrievalHitRate, {"k": 3}, lambda p, t, k=3: _np_hit_rate(p, t, k=3), "pos"),
+            (RetrievalNormalizedDCG, {}, lambda p, t, k=None: _np_ndcg(p, t), "pos"),
+        ],
+        ids=["map", "mrr", "precision", "recall", "fall_out", "hit_rate", "ndcg"],
+    )
+    def test_rows_dropped(self, cls, args, oracle, empty_on):
+        rng = np.random.default_rng(23)
+        preds = rng.random((3, 32)).astype(np.float32)
+        target = rng.integers(0, 2, size=(3, 32))
+        indexes = rng.integers(0, N_QUERIES, size=(3, 32))
+        # poison ~25% of rows with the ignored sentinel
+        poison = rng.random((3, 32)) < 0.25
+        target = np.where(poison, -100, target)
+
+        metric = cls(ignore_index=-100, **args)
+        got = _stream(metric, preds, target, indexes)
+
+        keep = ~poison.reshape(-1)
+        want = _np_mean_over_queries(
+            preds.reshape(-1)[keep], target.reshape(-1)[keep], indexes.reshape(-1)[keep],
+            oracle, empty_on=empty_on,
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestAdversarialLayouts:
+    def test_single_query_split_across_every_update(self):
+        """All rows of one query arrive one-per-update: grouping must span the
+        whole stream, not each update call."""
+        rng = np.random.default_rng(5)
+        preds = rng.random(16).astype(np.float32)
+        target = rng.integers(0, 2, size=16)
+        target[0] = 1  # non-empty
+        metric = RetrievalMAP()
+        for i in range(16):
+            metric.update(
+                jnp.asarray(preds[i : i + 1]),
+                jnp.asarray(target[i : i + 1]),
+                indexes=jnp.asarray([0]),
+            )
+        np.testing.assert_allclose(float(metric.compute()), _np_ap(preds, target), atol=1e-5)
+
+    def test_interleaved_vs_sorted_queries_identical(self):
+        rng = np.random.default_rng(13)
+        preds = rng.random(64).astype(np.float32)
+        target = rng.integers(0, 2, size=64)
+        indexes = rng.integers(0, 5, size=64)
+        order = np.argsort(indexes, kind="stable")
+
+        a, b = RetrievalMRR(), RetrievalMRR()
+        a.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        b.update(
+            jnp.asarray(preds[order]), jnp.asarray(target[order]), indexes=jnp.asarray(indexes[order])
+        )
+        np.testing.assert_allclose(float(a.compute()), float(b.compute()), atol=1e-6)
+
+    def test_noncontiguous_query_ids(self):
+        """Query ids need not be dense: {7, 1000, 12345} must group fine."""
+        preds = np.asarray([0.9, 0.1, 0.8, 0.3, 0.7, 0.2], np.float32)
+        target = np.asarray([1, 0, 0, 1, 1, 0])
+        indexes = np.asarray([7, 7, 1000, 1000, 12345, 12345])
+        metric = RetrievalMAP()
+        metric.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        want = np.mean([_np_ap(preds[:2], target[:2]), _np_ap(preds[2:4], target[2:4]), _np_ap(preds[4:], target[4:])])
+        np.testing.assert_allclose(float(metric.compute()), want, atol=1e-5)
+
+    def test_missing_indexes_raises(self):
+        metric = RetrievalMAP()
+        with pytest.raises((ValueError, TypeError)):
+            metric.update(jnp.asarray([0.5]), jnp.asarray([1]))
+
+    def test_shape_mismatch_raises(self):
+        metric = RetrievalMAP()
+        with pytest.raises(ValueError):
+            metric.update(
+                jnp.asarray([0.5, 0.2]), jnp.asarray([1]), indexes=jnp.asarray([0, 0])
+            )
