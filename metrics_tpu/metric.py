@@ -972,11 +972,19 @@ class Metric(ABC):
         if n == 0:
             return  # an empty stack is zero update() calls
 
-        def _slice(index) -> tuple:
-            """(args, kwargs) at one slice/range; non-array leaves unchanged."""
-            it = (x[index] for x, b in zip(all_leaves, is_batched) if b)
+        def _rebuild(batched_leaves) -> tuple:
+            """(args, kwargs) from the batched leaves + static leaves.
+
+            The single leaf-reconstruction contract shared by the eager loop,
+            the vmap variant, and the scan body below.
+            """
+            it = iter(batched_leaves)
             leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
             return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        def _slice(index) -> tuple:
+            """(args, kwargs) at one slice/range; non-array leaves unchanged."""
+            return _rebuild(x[index] for x, b in zip(all_leaves, is_batched) if b)
 
         def _loop_fallback(start: int = 0) -> None:
             for i in range(start, n):
@@ -1052,9 +1060,7 @@ class Metric(ABC):
                 n_eff = jax.tree_util.tree_leaves(arr_stack)[0].shape[0]
 
                 def one_slice(sl: tuple) -> Dict[str, Any]:
-                    it = iter(sl)
-                    leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
-                    sl_args, sl_kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+                    sl_args, sl_kwargs = _rebuild(sl)
                     _, new = self._run_with_state(
                         dict(default_state), self._update_impl, sl_args, sl_kwargs
                     )
@@ -1084,9 +1090,7 @@ class Metric(ABC):
         def _build_scan_variant() -> Callable:
             def pure_update_many(state: Dict[str, Any], arr_stack: tuple) -> Dict[str, Any]:
                 def body(st: Dict[str, Any], sl: tuple) -> tuple:
-                    it = iter(sl)
-                    leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
-                    sl_args, sl_kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+                    sl_args, sl_kwargs = _rebuild(sl)
                     _, new = self._run_with_state(st, self._update_impl, sl_args, sl_kwargs)
                     return new, None
 
