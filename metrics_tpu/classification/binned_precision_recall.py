@@ -110,25 +110,37 @@ class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
         self.min_precision = min_precision
 
     def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
-        """Max recall with precision >= min_precision; threshold 1e6 if none."""
+        """Max recall with precision >= min_precision; threshold 1e6 if none.
+
+        Tie-break matches the reference's lexicographic ``max((r, p, t))``
+        (reference ``classification/binned_precision_recall.py:24-42``): among
+        thresholds tying on max recall, prefer the highest precision, then the
+        highest threshold.  The sentinel curve point (precision=1, recall=0)
+        appended by the base class carries no threshold and is excluded, as
+        the reference's ``zip`` truncation does.
+        """
         precisions, recalls, thresholds = super().compute()
         if self.num_classes == 1:
             precisions = jnp.stack([precisions])
             recalls = jnp.stack([recalls])
-            thresholds = [thresholds]
+            thr = thresholds
         else:
             precisions = jnp.stack(precisions)
             recalls = jnp.stack(recalls)
-        thresholds_padded = jnp.concatenate(
-            [thresholds[0], jnp.asarray([1e6], dtype=thresholds[0].dtype)]
-        )
-        condition = precisions >= self.min_precision
-        masked_recalls = jnp.where(condition, recalls, 0.0)
-        best = jnp.argmax(masked_recalls, axis=1)
-        max_recall = jnp.take_along_axis(masked_recalls, best[:, None], axis=1)[:, 0]
-        best_thresholds = jnp.where(
-            max_recall == 0, 1e6, thresholds_padded[jnp.minimum(best, thresholds_padded.size - 1)]
-        )
+            thr = thresholds[0]
+        n = thr.size
+        p = precisions[:, :n]
+        r = recalls[:, :n]
+        valid = p >= self.min_precision
+        r_m = jnp.where(valid, r, -jnp.inf)
+        max_r = jnp.max(r_m, axis=1)
+        tie_r = valid & (r_m == max_r[:, None])
+        p_m = jnp.where(tie_r, p, -jnp.inf)
+        max_p = jnp.max(p_m, axis=1)
+        tie_rp = tie_r & (p == max_p[:, None])
+        best_thresholds = jnp.max(jnp.where(tie_rp, thr[None, :], -jnp.inf), axis=1)
+        max_recall = jnp.where(jnp.any(valid, axis=1), max_r, 0.0)
+        best_thresholds = jnp.where(max_recall == 0, 1e6, best_thresholds).astype(thr.dtype)
         if self.num_classes == 1:
             return max_recall[0], best_thresholds[0]
         return max_recall, best_thresholds
