@@ -692,8 +692,13 @@ class MeanAveragePrecision(Metric):
             return float(r.mean()) if r.size else -1.0
 
         last_det = self.max_detection_thresholds[-1]
+        # "map" is pinned to maxDets=100, matching both pycocotools'
+        # summarize table (stats[0] uses the hardcoded default) and the
+        # reference (mean_ap.py:689): with custom thresholds not containing
+        # 100 it is the -1 sentinel.  map_50/75/small/medium/large use the
+        # largest threshold, again per both oracles.
         results: Dict[str, Any] = {
-            "map": ap(max_det=last_det),
+            "map": ap(max_det=100) if 100 in self.max_detection_thresholds else -1.0,
             "map_50": ap(iou_thr=0.5, max_det=last_det) if 0.5 in self.iou_thresholds else -1.0,
             "map_75": ap(iou_thr=0.75, max_det=last_det) if 0.75 in self.iou_thresholds else -1.0,
             "map_small": ap(area="small", max_det=last_det),
@@ -706,8 +711,14 @@ class MeanAveragePrecision(Metric):
         results["mar_medium"] = ar(area="medium", max_det=last_det)
         results["mar_large"] = ar(area="large", max_det=last_det)
         if self.class_metrics:
+            # per-class map inherits the same maxDets=100 pin as "map"
+            # (reference mean_ap.py:916 calls _summarize with its default)
             results["map_per_class"] = np.asarray(
-                [ap(max_det=last_det, k=i) for i in range(len(classes))], dtype=np.float32
+                [
+                    ap(max_det=100, k=i) if 100 in self.max_detection_thresholds else -1.0
+                    for i in range(len(classes))
+                ],
+                dtype=np.float32,
             )
             results[f"mar_{last_det}_per_class"] = np.asarray(
                 [ar(max_det=last_det, k=i) for i in range(len(classes))], dtype=np.float32
