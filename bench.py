@@ -254,7 +254,7 @@ def _bench_image(n_batches=64, batch_size=128):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # random-init warning is recorded via the flag below
         fid = FrechetInceptionDistance(
-            feature=2048, extractor_batch=256, extractor_dtype=jnp.bfloat16
+            feature=2048, extractor_batch=128, extractor_dtype=jnp.bfloat16
         )
     pretrained = load_inception_variables() is not None
     launches = {"n": 0, "images": 0}
@@ -274,28 +274,56 @@ def _bench_image(n_batches=64, batch_size=128):
             fid.update(ua, real=True)
             fid.update(ub, real=False)
 
+    def barrier(*metrics):
+        """Completion barrier on every metric's device state: flush lazy
+        updates, then block on all state leaves.  Without this, async
+        dispatch books the stream's device work (SSIM convs, extractor
+        forwards) into whichever later phase first fetches a value
+        (round-4 verdict weak #2)."""
+        for m in metrics:
+            m._flush_pending()
+            m._flush_host_buffers()
+            jax.block_until_ready(jax.tree_util.tree_leaves(m._state))
+
     stream()  # warm every trace incl. the chunked extractor + computes
     for m in (psnr, ssim, fid):
         np.asarray(m.compute())  # value fetch = completion barrier
         m.reset()
     launches["n"] = launches["images"] = 0
+
+    # headline pass: fully async stream, total walltime only
     start = time.perf_counter()
     stream()
+    np.asarray(psnr.compute())
+    np.asarray(ssim.compute())
+    np.asarray(fid.compute())
+    total = time.perf_counter() - start
+
+    # attribution pass: barriers between phases so each number is the wall
+    # time of that phase's own work (sums to >= the async headline total)
+    for m in (psnr, ssim, fid):
+        m.reset()
+    launches["n"] = launches["images"] = 0
+    start = time.perf_counter()
+    stream()
+    barrier(psnr, ssim, fid)
     t_stream = time.perf_counter() - start
     np.asarray(psnr.compute())
     np.asarray(ssim.compute())
     t_psnr_ssim = time.perf_counter() - start - t_stream
     np.asarray(fid.compute())
-    total = time.perf_counter() - start
+    t_fid = time.perf_counter() - start - t_stream - t_psnr_ssim
+
     n_img = n_batches * batch_size
     split = {
         "images": n_img,
-        "stream_secs": round(t_stream, 3),
-        "psnr_ssim_compute_secs": round(t_psnr_ssim, 3),
-        "fid_compute_secs": round(total - t_stream - t_psnr_ssim, 3),
+        "async_total_secs": round(total, 3),
+        "stream_secs_barriered": round(t_stream, 3),
+        "psnr_ssim_compute_secs_barriered": round(t_psnr_ssim, 3),
+        "fid_compute_secs_barriered": round(t_fid, 3),
         "extractor_launches": launches["n"],
         "extractor_images": launches["images"],
-        "extractor_chunk": 256,
+        "extractor_chunk": 128,  # optimized extractor's fastest batch (r5)
         "extractor_dtype": "bf16",
     }
     return n_img / total, pretrained, split
@@ -405,6 +433,18 @@ def _bench_text(n_batches=128, sentences_per_batch=32):
     t_rouge_compute = time.perf_counter() - t0
     total = t_bert_update + t_rouge_update + t_bert_compute + t_rouge_compute
     n_sent = n_batches * sentences_per_batch
+
+    # attribution pass (round-4 ask #3): same data, barriers between compute
+    # phases so each wall number is honest — separate from the timed run
+    # because the barriers serialize work the async stream overlaps.
+    bert.reset()
+    for preds, target in batches:
+        bert.update(preds, target)
+    bert.profile_compute = True
+    fetch(bert.compute())
+    bert.profile_compute = False
+    breakdown = dict(bert.last_compute_breakdown)
+
     split = {
         "sentences": n_sent,
         "tokenize_sentences_per_sec": round(2 * n_sent / t_tokenize, 1),
@@ -412,6 +452,12 @@ def _bench_text(n_batches=128, sentences_per_batch=32):
         "rouge_update_secs": round(t_rouge_update, 3),
         "bert_compute_secs": round(t_bert_compute, 3),
         "rouge_compute_secs": round(t_rouge_compute, 3),
+        # update-time eager chunk encoding (round 5): bert_update enqueues
+        # the encoder asynchronously, so the device encodes while the host
+        # tokenizes rouge updates; bert_compute keeps only the tail +
+        # matching + fetch.  The barriered breakdown below prices each
+        # compute phase; in the timed run those phases overlap the updates.
+        "bert_compute_breakdown": breakdown,
         "encoder_chunk": 512,
         "encoder_dtype": "bf16",  # matching/scores stay f32
     }

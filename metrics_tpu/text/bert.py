@@ -75,6 +75,69 @@ class BERTScore(Metric):
         self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
         self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
         self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+        # eager-encode cache: embeddings for a PREFIX of the stored token
+        # batches, launched asynchronously during update so the device
+        # encoder overlaps host-side tokenization (the reference embeds the
+        # whole corpus inside compute, text/bert.py:205-225).  Purely a
+        # derived cache: validity is checked by object identity against the
+        # live state lists, so any state swap (sync gather, load_state_dict,
+        # forward's state juggling) falls back to a full compute-time encode.
+        self._enc_src: List[Array] = []  # state arrays the cache covers
+        self._enc_cache: Dict[str, List[Array]] = {"p": [], "t": []}
+        self._in_forward_batch = False
+        self.profile_compute = False
+        self.last_compute_breakdown: Dict[str, float] = {}
+
+    def _invalidate_encoder_cache(self) -> None:
+        self._enc_src = []
+        self._enc_cache = {"p": [], "t": []}
+
+    def reset(self) -> None:
+        self._invalidate_encoder_cache()
+        super().reset()
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self._invalidate_encoder_cache()
+        super().load_state_dict(state_dict)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """forward() juggles state (swap to batch, compute, merge back), so
+        the eager-encode cache is suspended for the batch update (encoding
+        the batch would be thrown away with the swapped state) and dropped
+        afterwards (the merged lists no longer match the cached prefix)."""
+        self._in_forward_batch = True
+        try:
+            return super().forward(*args, **kwargs)
+        finally:
+            self._in_forward_batch = False
+            self._invalidate_encoder_cache()
+
+    def load_state_pytree(self, tree: Dict[str, Any]) -> None:
+        self._invalidate_encoder_cache()
+        super().load_state_pytree(tree)
+
+    def _cache_is_prefix(self) -> bool:
+        stored = self.preds_input_ids
+        src = self._enc_src
+        return len(src) <= len(stored) and all(a is b for a, b in zip(src, stored))
+
+    def _encode_block(self, ids_list, mask_list, side: str) -> None:
+        ids = ids_list[0] if len(ids_list) == 1 else jnp.concatenate(ids_list, axis=0)
+        mask = mask_list[0] if len(mask_list) == 1 else jnp.concatenate(mask_list, axis=0)
+        emb = _model_forward(self.model, ids, mask, self.num_layers, self.all_layers, self.batch_size)
+        self._enc_cache[side].append(emb)
+
+    def _drain_pending_encodes(self) -> None:
+        """Encode every stored batch beyond the cached prefix once at least
+        ``batch_size`` sentences are pending.  Launches are async: update
+        returns while the encoder chunks queue behind earlier work."""
+        start = len(self._enc_src)
+        pend = self.preds_input_ids[start:]
+        if sum(int(x.shape[0]) for x in pend) < max(1, self.batch_size):
+            return
+        self._encode_block(pend, self.preds_attention_mask[start:], "p")
+        self._encode_block(self.target_input_ids[start:], self.target_attention_mask[start:], "t")
+        self._enc_src.extend(pend)
 
     def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
         preds_l = [preds] if isinstance(preds, str) else list(preds)
@@ -87,23 +150,66 @@ class BERTScore(Metric):
         self.preds_attention_mask.append(jnp.asarray(p_tok["attention_mask"]))
         self.target_input_ids.append(jnp.asarray(t_tok["input_ids"]))
         self.target_attention_mask.append(jnp.asarray(t_tok["attention_mask"]))
+        if self.user_forward_fn is None and not self._in_forward_batch:
+            if self._cache_is_prefix():
+                self._drain_pending_encodes()
+            else:
+                # state lists were swapped under us (sync gather, manual
+                # surgery): drop the stale device embeddings; the cache
+                # restarts from the current lists on the next update
+                self._invalidate_encoder_cache()
 
     def compute(self) -> Dict[str, List[float]]:
         # token states stay ON DEVICE through the encoder: round-tripping
         # them through numpy pays a d2h fetch plus one h2d per encoder chunk
         # (seconds over a remote-TPU tunnel); only the idf path needs host
-        # token ids, and fetches them just then
+        # token ids, and fetches them just then.
+        #
+        # ``self.profile_compute = True`` inserts block_until_ready barriers
+        # between phases and records wall times in
+        # ``last_compute_breakdown`` — off by default because the barriers
+        # serialize work the async dispatch would otherwise overlap.  (An
+        # attribute rather than a kwarg: the Metric base wraps ``compute``
+        # and calls the implementation with no arguments.)
+        import time as _time
+
+        profile = self.profile_compute
+        bd: Dict[str, float] = {}
+
+        def _tick(key, value, *barrier):
+            if profile:
+                for b in barrier:
+                    jax.block_until_ready(b)
+                bd[key] = round(_time.perf_counter() - value, 4)
+            return _time.perf_counter()
+
+        t0 = _time.perf_counter()
         p_ids = jnp.concatenate(self.preds_input_ids, axis=0)
         p_mask = jnp.concatenate(self.preds_attention_mask, axis=0)
         t_ids = jnp.concatenate(self.target_input_ids, axis=0)
         t_mask = jnp.concatenate(self.target_attention_mask, axis=0)
+        t0 = _tick("concat_secs", t0, p_ids, p_mask, t_ids, t_mask)
 
         if self.user_forward_fn is not None:
             p_emb = self.user_forward_fn(self.model, p_ids, p_mask)
             t_emb = self.user_forward_fn(self.model, t_ids, t_mask)
+        elif self._cache_is_prefix() and self._enc_src:
+            # eager cache covers a prefix of the stored batches (async
+            # launches already queued during update); encode only the tail
+            start = len(self._enc_src)
+            tail = self.preds_input_ids[start:]
+            if tail:
+                self._encode_block(tail, self.preds_attention_mask[start:], "p")
+                self._encode_block(self.target_input_ids[start:], self.target_attention_mask[start:], "t")
+                self._enc_src.extend(tail)
+            p_chunks, t_chunks = self._enc_cache["p"], self._enc_cache["t"]
+            p_emb = p_chunks[0] if len(p_chunks) == 1 else jnp.concatenate(p_chunks, axis=-3)
+            t_emb = t_chunks[0] if len(t_chunks) == 1 else jnp.concatenate(t_chunks, axis=-3)
+            bd["encoder_cached_chunks"] = len(p_chunks)
         else:
             p_emb = _model_forward(self.model, p_ids, p_mask, self.num_layers, self.all_layers, self.batch_size)
             t_emb = _model_forward(self.model, t_ids, t_mask, self.num_layers, self.all_layers, self.batch_size)
+        t0 = _tick("encoder_secs", t0, p_emb, t_emb)
 
         if self.idf:
             p_ids_np, p_mask_np = np.asarray(p_ids), np.asarray(p_mask)
@@ -114,6 +220,7 @@ class BERTScore(Metric):
         else:
             pw = jnp.ones(p_ids.shape, dtype=jnp.float32)
             tw = jnp.ones(t_ids.shape, dtype=jnp.float32)
+        t0 = _tick("idf_secs", t0)
 
         out = _run_matching(
             # matching always runs f32: a bf16 user model (MXU-rate encoding)
@@ -126,11 +233,15 @@ class BERTScore(Metric):
             if self.baseline_values is None:
                 raise ValueError("`rescale_with_baseline` needs `baseline_values` in offline builds.")
             out = {k: (v - self.baseline_values[k]) / (1.0 - self.baseline_values[k]) for k, v in out.items()}
+        t0 = _tick("matching_secs", t0, out)
         # ONE stacked device->host fetch for all three outputs (per-key
         # fetches pay one transfer round trip each over a remote device)
         keys = list(out)
         stacked = np.asarray(jnp.stack([jnp.asarray(out[k]) for k in keys]))
         result = {k: stacked[i].tolist() for i, k in enumerate(keys)}
+        _tick("fetch_secs", t0)
+        if profile:
+            self.last_compute_breakdown = bd
         if self.return_hash:
             result["hash"] = f"metrics_tpu-bert_score-{self.model_name_or_path or 'user-model'}"
         return result

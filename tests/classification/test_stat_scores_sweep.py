@@ -130,6 +130,11 @@ def _sk_prf(preds, target, regime, metric, average, beta=1.0):
     """sklearn oracle for precision/recall/fbeta over any label regime."""
     p, t = _to_labels(preds, target, regime)
     if regime.startswith("binary"):
+        # binary regimes are excluded from the averaged sweep; guard against
+        # a future caller silently comparing the wrong oracle
+        assert average == "micro", (
+            "binary _sk_prf ignores `average`; only the micro default is valid"
+        )
         kw = {"average": "binary"}
     elif regime.startswith("multilabel"):
         kw = {"average": _SK_AVG[average], "zero_division": 0}

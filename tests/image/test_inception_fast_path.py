@@ -1,0 +1,64 @@
+"""The optimized Inception path (BN folding + fused 1x1 heads) must be
+value-equivalent to the canonical Flax module on the same weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.image.backbones.inception import (
+    FlaxInceptionV3,
+    InceptionFeatureExtractor,
+    fast_inception_apply,
+    fold_inception_variables,
+)
+
+
+@pytest.fixture(scope="module")
+def canonical():
+    model = FlaxInceptionV3(fid_variant=True)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(7), jnp.zeros((1, 75, 75, 3)))
+    return model, variables
+
+
+def test_fold_matches_canonical_all_taps(canonical):
+    model, variables = canonical
+    fast = fold_inception_variables(variables)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, 75, 75, 3), jnp.float32)
+    want = model.apply(variables, x)
+    got = fast_inception_apply(fast, x, fid_variant=True)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(want[k]), atol=5e-4, rtol=5e-4, err_msg=k
+        )
+
+
+def test_fold_matches_canonical_textbook_variant():
+    model = FlaxInceptionV3(fid_variant=False)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(3), jnp.zeros((1, 75, 75, 3)))
+    fast = fold_inception_variables(variables)
+    x = jax.random.uniform(jax.random.PRNGKey(2), (2, 75, 75, 3), jnp.float32)
+    want = model.apply(variables, x)
+    got = fast_inception_apply(fast, x, fid_variant=False)
+    np.testing.assert_allclose(
+        np.asarray(got["2048"]), np.asarray(want["2048"]), atol=5e-4, rtol=5e-4
+    )
+
+
+def test_extractor_optimized_matches_reference_path():
+    imgs = (np.random.default_rng(0).random((3, 3, 64, 64)) * 255).astype(np.uint8)
+    base = InceptionFeatureExtractor(feature="2048", optimized=False)
+    fast = InceptionFeatureExtractor(feature="2048", optimized=True)
+    a = np.asarray(base(imgs))
+    b = np.asarray(fast(imgs))
+    np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_extractor_optimized_bf16_runs():
+    imgs = (np.random.default_rng(1).random((2, 3, 64, 64)) * 255).astype(np.uint8)
+    fast = InceptionFeatureExtractor(
+        feature="192", optimized=True, compute_dtype=jnp.bfloat16
+    )
+    out = np.asarray(fast(imgs))
+    assert out.shape == (2, 192) and np.isfinite(out).all()

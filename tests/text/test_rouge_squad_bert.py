@@ -217,6 +217,17 @@ class TestBERTScore(TextTester):
             bert_score(["a"], ["a"])
 
 
+class _HashTok:
+    """Module-level so pickled BERTScore instances round-trip."""
+
+    def __call__(self, texts, padding=None, max_length=16, truncation=True, return_attention_mask=True):
+        ids = [[(hash(w) % 95) + 1 for w in t.split()][:max_length] for t in texts]
+        return {
+            "input_ids": [i + [0] * (max_length - len(i)) for i in ids],
+            "attention_mask": [[1] * len(i) + [0] * (max_length - len(i)) for i in ids],
+        }
+
+
 class TestBERTScoreFlaxEncoder:
     """Exercise the real HF-Flax encoder path (tiny random config, offline)."""
 
@@ -229,16 +240,7 @@ class TestBERTScoreFlaxEncoder:
             intermediate_size=32, max_position_embeddings=32,
         )
         model = FlaxBertModel(cfg, seed=0)
-
-        class Tok:
-            def __call__(self, texts, padding=None, max_length=16, truncation=True, return_attention_mask=True):
-                ids = [[(hash(w) % 95) + 1 for w in t.split()][:max_length] for t in texts]
-                return {
-                    "input_ids": [i + [0] * (max_length - len(i)) for i in ids],
-                    "attention_mask": [[1] * len(i) + [0] * (max_length - len(i)) for i in ids],
-                }
-
-        return model, Tok()
+        return model, _HashTok()
 
     def test_hf_model_forward_paths(self):
         model, tok = self._setup()
@@ -262,6 +264,71 @@ class TestBERTScoreFlaxEncoder:
         metric.update(["x y", "p q r"], ["x z", "p q s"])
         out = metric.compute()
         assert len(out["f1"]) == 3
+
+    def test_eager_encode_cache_matches_full_encode(self):
+        """Round-5 pipelined encoder: update-time eager chunk encoding must
+        be value-identical to the compute-time full encode."""
+        model, tok = self._setup()
+        preds = [f"w{i} w{i+1} w{i+2}" for i in range(12)]
+        target = [f"w{i} z{i+1} w{i+2}" for i in range(12)]
+        # batch_size=4 -> eager drains fire during the update stream
+        eager = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=4)
+        for s in range(0, 12, 3):
+            eager.update(preds[s : s + 3], target[s : s + 3])
+        assert eager._enc_src, "eager cache never populated"
+        # lazy path: same metric with the cache bypassed via user_forward_fn-
+        # free full encode (invalidate before compute)
+        lazy = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=4)
+        for s in range(0, 12, 3):
+            lazy.update(preds[s : s + 3], target[s : s + 3])
+        lazy._invalidate_encoder_cache()
+        a, b = eager.compute(), lazy.compute()
+        for k in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(a[k], b[k], atol=1e-6)
+
+    def test_forward_suspends_eager_cache(self):
+        """forward() must return the batch value, keep global accumulation
+        correct, and neither populate nor retain the eager-encode cache
+        (its state juggling would strand the embeddings)."""
+        model, tok = self._setup()
+        m = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=2)
+        batches = [(["a b c", "d e f"], ["a b d", "d e g"]),
+                   (["h i", "j k l"], ["h i", "j x l"])]
+        vals = [m.forward(p, t) for p, t in batches]
+        assert not m._enc_src and not m._enc_cache["p"]
+        for (p, t), v in zip(batches, vals):
+            solo = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=2)
+            solo.update(p, t)
+            np.testing.assert_allclose(v["f1"], solo.compute()["f1"], atol=1e-6)
+        ref = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=2)
+        for p, t in batches:
+            ref.update(p, t)
+        np.testing.assert_allclose(m.compute()["f1"], ref.compute()["f1"], atol=1e-6)
+
+    def test_eager_encode_cache_invalidation_paths(self):
+        """reset() clears the cache; load_state_dict invalidates it; a
+        pickled clone keeps producing correct values."""
+        import pickle
+
+        model, tok = self._setup()
+        m = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=2)
+        m.update(["a b c", "d e"], ["a b d", "d f"])
+        assert m._enc_src
+        m.reset()
+        assert not m._enc_src and not m._enc_cache["p"]
+        m.update(["a b c", "d e"], ["a b d", "d f"])
+        want = m.compute()
+
+        m2 = BERTScore(model=model, user_tokenizer=tok, max_length=16, batch_size=2)
+        m2.update(["x", "y"], ["x", "z"])  # populate a cache that must die
+        m2.load_state_pytree(m.state_pytree())
+        got = m2.compute()
+        np.testing.assert_allclose(got["f1"], want["f1"], atol=1e-6)
+
+        m3 = pickle.loads(pickle.dumps(m))
+        m3.update(["g h"], ["g i"])
+        out3 = m3.compute()
+        assert len(out3["f1"]) == 3 and all(np.isfinite(out3["f1"]))
 
 
 class TestHostAccumulation:
