@@ -776,8 +776,53 @@ def _bench_map_segm_scale(n_img=500, canvas=(480, 640)):
     t_compute = time.perf_counter() - start
     prof = {k: round(v, 4) for k, v in getattr(metric, "last_compute_profile", {}).items()}
     prof["update"] = round(t_update, 4)
+    prof["update_breakdown"] = dict(metric.last_update_profile)
     prof["compute_total"] = round(t_compute, 4)
     prof["map"] = round(float(out["map"]), 4)
+
+    # dense ingest is a host memory scan; record the host's own memcpy
+    # ceiling so "at the ceiling" is auditable
+    buf = np.ones(200 * 1024 * 1024, np.uint8)
+    bw = []
+    for _ in range(3):
+        start = time.perf_counter()
+        buf2 = buf.copy()
+        bw.append(2 * buf.nbytes / (time.perf_counter() - start) / 1e9)
+        del buf2
+    prof["host_memcpy_gb_per_sec"] = round(float(np.median(bw)), 2)
+    del buf
+    prof["mask_bytes_scanned_gb"] = round(
+        sum(p["masks"].nbytes for p in preds) + sum(t["masks"].nbytes for t in targets), 2
+    ) / 1e9
+
+    # RLE-dict ingest variant (round 5): COCO gt ships as RLE; pre-encoded
+    # inputs skip the dense scan entirely.  Encoding below is setup, not
+    # timed — it models a pipeline whose masks are already RLE.
+    from metrics_tpu.detection.mean_ap import rle_to_coco_string
+    from metrics_tpu._native import rle_encode
+
+    def to_rle(batch, keep):
+        out_b = []
+        for d in batch:
+            dicts = [
+                {"size": list(m.shape), "counts": rle_to_coco_string(rle_encode(m))}
+                for m in d["masks"]
+            ]
+            out_b.append({**{k: d[k] for k in keep}, "masks": dicts})
+        return out_b
+
+    rle_preds = to_rle(preds, ("scores", "labels"))
+    rle_targets = to_rle(targets, ("labels",))
+    metric2 = MeanAveragePrecision(iou_type="segm")
+    start = time.perf_counter()
+    metric2.update(rle_preds, rle_targets)
+    t_update_rle = time.perf_counter() - start
+    start = time.perf_counter()
+    out2 = metric2.compute()
+    t_compute_rle = time.perf_counter() - start
+    assert abs(float(out2["map"]) - float(out["map"])) < 1e-9
+    prof["rle_ingest_update"] = round(t_update_rle, 4)
+    prof["rle_ingest_images_per_sec"] = round(n_img / (t_update_rle + t_compute_rle), 1)
     return n_img / (t_update + t_compute), prof
 
 

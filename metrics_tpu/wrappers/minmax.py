@@ -54,8 +54,21 @@ class MinMaxMetric(Metric):
         return {"raw": val, "max": self.max_val, "min": self.min_val}
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
-        self._update_wrapper(*args, **kwargs)
-        return self._compute_wrapper()
+        """Standard forward contract (reference ``Metric.forward`` through the
+        wrapper): ``raw`` is the BATCH value — the inner metric's own forward —
+        while the inner state keeps accumulating; min/max track the values
+        this wrapper has returned (live-reference parity pinned by
+        ``tests/test_reference_parity.py::test_wrapper_classes_match_reference``)."""
+        val = jnp.asarray(self._base_metric.forward(*args, **kwargs))
+        self._update_count += 1  # forward IS an update for the staleness warning
+        self._computed = None
+        if not self._is_suitable_val(val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {val}"
+            )
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
 
     def reset(self) -> None:
         self.min_val = jnp.asarray(float("inf"))

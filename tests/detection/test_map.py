@@ -538,6 +538,106 @@ class TestSegmIrregularDenseOracle:
             assert abs(float(out[key]) - val) < 1e-6, (key, float(out[key]), val)
 
 
+class TestRLEDictIngest:
+    """Round-5: update() accepts pycocotools-style RLE dicts for `masks`,
+    skipping the dense-mask scan (COCO gt ships as RLE; the scan is the
+    entire segm ingest cost on a bandwidth-bound host)."""
+
+    @staticmethod
+    def _fixture(n_img=6, h=64, w=80, seed=3):
+        rng = np.random.default_rng(seed)
+        yy, xx = np.mgrid[0:h, 0:w]
+        preds, targets = [], []
+        for _ in range(n_img):
+            def blobs(n):
+                cy = rng.integers(10, h - 10, n)
+                cx = rng.integers(10, w - 10, n)
+                r = rng.integers(4, 14, n)
+                return np.stack(
+                    [((yy - cy[i]) ** 2 + (xx - cx[i]) ** 2) < r[i] ** 2 for i in range(n)]
+                ).astype(np.uint8)
+            gt = blobs(3)
+            dt = np.concatenate([gt[:2], blobs(2)])
+            preds.append(dict(masks=dt, scores=rng.random(4), labels=rng.integers(0, 3, 4)))
+            targets.append(dict(masks=gt, labels=rng.integers(0, 3, 3)))
+        return preds, targets
+
+    @staticmethod
+    def _to_rle_dicts(masks, compressed):
+        from metrics_tpu._native import rle_encode
+        from metrics_tpu.detection.mean_ap import rle_to_coco_string
+
+        out = []
+        for m in masks:
+            runs = rle_encode(m)
+            counts = rle_to_coco_string(runs) if compressed else [int(v) for v in runs]
+            out.append({"size": [int(m.shape[0]), int(m.shape[1])], "counts": counts})
+        return out
+
+    def test_codec_roundtrip_fuzz(self):
+        from metrics_tpu.detection.mean_ap import rle_from_coco_string, rle_to_coco_string
+
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            n = int(rng.integers(1, 40))
+            runs = rng.integers(0, 5000, n).astype(np.int64)
+            # decreasing deltas exercise the negative-varint sign extension
+            got = rle_from_coco_string(rle_to_coco_string(runs))
+            np.testing.assert_array_equal(got.astype(np.int64), runs)
+
+    @pytest.mark.parametrize("compressed", [False, True])
+    def test_rle_dict_ingest_matches_dense(self, compressed):
+        preds, targets = self._fixture()
+        dense = MeanAveragePrecision(iou_type="segm")
+        dense.update(preds, targets)
+        want = dense.compute()
+        assert dense.last_update_profile["ingest_secs"] >= 0
+
+        rle_preds = [
+            dict(p, masks=self._to_rle_dicts(p["masks"], compressed)) for p in preds
+        ]
+        rle_targets = [
+            dict(t, masks=self._to_rle_dicts(t["masks"], compressed)) for t in targets
+        ]
+        rle = MeanAveragePrecision(iou_type="segm")
+        rle.update(rle_preds, rle_targets)
+        got = rle.compute()
+        for key in want:
+            np.testing.assert_allclose(
+                np.asarray(got[key], np.float64), np.asarray(want[key], np.float64),
+                atol=1e-9, err_msg=key,
+            )
+
+    def test_mixed_dense_preds_rle_targets(self):
+        """The realistic COCO shape: model emits dense masks, gt is RLE."""
+        preds, targets = self._fixture(seed=5)
+        rle_targets = [dict(t, masks=self._to_rle_dicts(t["masks"], True)) for t in targets]
+        a = MeanAveragePrecision(iou_type="segm")
+        a.update(preds, targets)
+        b = MeanAveragePrecision(iou_type="segm")
+        b.update(preds, rle_targets)
+        np.testing.assert_allclose(
+            float(np.asarray(a.compute()["map"])), float(np.asarray(b.compute()["map"])), atol=1e-9
+        )
+
+    def test_bad_rle_inputs_raise(self):
+        m = MeanAveragePrecision(iou_type="segm")
+        good = {"size": [8, 8], "counts": [32, 32]}
+        short = {"size": [8, 8], "counts": [10, 10]}
+        with pytest.raises(ValueError, match="sum to the canvas"):
+            m.update([dict(masks=[short], scores=np.ones(1), labels=np.zeros(1, int))],
+                     [dict(masks=[good], labels=np.zeros(1, int))])
+        other_canvas = {"size": [4, 16], "counts": [32, 32]}
+        with pytest.raises(ValueError, match="share a canvas"):
+            m.update(
+                [dict(masks=[good, other_canvas], scores=np.ones(2), labels=np.zeros(2, int))],
+                [dict(masks=[good], labels=np.zeros(1, int))],
+            )
+        with pytest.raises(ValueError, match="size.*counts|counts.*size"):
+            m.update([dict(masks=[{"counts": [64]}], scores=np.ones(1), labels=np.zeros(1, int))],
+                     [dict(masks=[good], labels=np.zeros(1, int))])
+
+
 class TestRound4NativeKernels:
     """Round-4 batched kernels: batch RLE encode and segmented tables."""
 

@@ -621,6 +621,39 @@ _MODULE_CASES = [
 ]
 
 
+def g_agg(n=40):
+    def gen(rng):
+        return (rng.normal(size=n).astype(np.float32),)
+
+    return gen
+
+
+def g_agg_nan(n=40):
+    def gen(rng):
+        x = rng.normal(size=n).astype(np.float32)
+        x[::7] = np.nan
+        return (x,)
+
+    return gen
+
+
+# aggregation classes (value + nan_strategy semantics) — previously only
+# self-oracled
+_MODULE_CASES += [
+    pytest.param("MeanMetric", {}, g_agg(), id="MeanMetric"),
+    pytest.param("SumMetric", {}, g_agg(), id="SumMetric"),
+    pytest.param("MaxMetric", {}, g_agg(), id="MaxMetric"),
+    pytest.param("MinMetric", {}, g_agg(), id="MinMetric"),
+    pytest.param("CatMetric", {}, g_agg(), id="CatMetric"),
+    pytest.param(
+        "MeanMetric", {"nan_strategy": "ignore"}, g_agg_nan(), id="MeanMetric-nanignore"
+    ),
+    pytest.param(
+        "SumMetric", {"nan_strategy": 0.0}, g_agg_nan(), id="SumMetric-nanzero"
+    ),
+]
+
+
 @pytest.mark.parametrize("cls_name, kwargs, gen", _MODULE_CASES)
 def test_module_class_matches_reference(cls_name, kwargs, gen):
     """Accumulate 3 batches through both module classes; compare every
@@ -637,6 +670,58 @@ def test_module_class_matches_reference(cls_name, kwargs, gen):
         out_ref = ref(*(_to_torch(a) for a in args))
         _assert_close(out_mine, out_ref, 2e-4, 1e-5, path=f"{cls_name}.forward")
     _assert_close(mine.compute(), ref.compute(), 2e-4, 1e-5, path=f"{cls_name}.compute")
+
+
+def test_wrapper_classes_match_reference():
+    """MinMax / Multioutput / Classwise wrappers around live inner metrics —
+    deterministic wrapper semantics compared stack-to-stack (BootStrapper is
+    stochastic and stays on its own statistical tests)."""
+    import metrics_tpu
+    import torchmetrics
+
+    rng = _rng_for("wrappers-minmax")
+    mine = metrics_tpu.MinMaxMetric(metrics_tpu.Accuracy(num_classes=5, average="macro"))
+    ref = torchmetrics.MinMaxMetric(torchmetrics.Accuracy(num_classes=5, average="macro"))
+    ref_upd = torchmetrics.MinMaxMetric(torchmetrics.Accuracy(num_classes=5, average="macro"))
+    gen = g_mc_prob()
+    all_args = []
+    for _ in range(3):
+        args = gen(rng)
+        all_args.append(args)
+        out_m = mine(*args)
+        out_r = ref(*(_to_torch(a) for a in args))
+        ref_upd.update(*(_to_torch(a) for a in args))
+        _assert_close(out_m, out_r, 2e-4, 1e-5, path="minmax.forward")
+    # compute-after-forward: the reference returns its inner metric's STALE
+    # compute cache (the last forward's batch value — upstream compute-cache
+    # staleness); we return the true accumulated value, which equals a
+    # reference metric driven by update() only.
+    _assert_close(
+        mine.compute()["raw"], ref_upd.compute()["raw"], 2e-4, 1e-5, path="minmax.raw"
+    )
+
+    rng = _rng_for("wrappers-multioutput")
+    mine = metrics_tpu.MultioutputWrapper(metrics_tpu.MeanSquaredError(), num_outputs=3)
+    ref = torchmetrics.MultioutputWrapper(torchmetrics.MeanSquaredError(), num_outputs=3)
+    gen = g_reg((32, 3))
+    for _ in range(3):
+        args = gen(rng)
+        out_m = mine(*args)
+        out_r = ref(*(_to_torch(a) for a in args))
+        _assert_close(out_m, out_r, 2e-4, 1e-5, path="multioutput.forward")
+    _assert_close(mine.compute(), ref.compute(), 2e-4, 1e-5, path="multioutput.compute")
+
+    rng = _rng_for("wrappers-classwise")
+    mine = metrics_tpu.ClasswiseWrapper(metrics_tpu.Accuracy(num_classes=4, average="none"))
+    ref = torchmetrics.ClasswiseWrapper(torchmetrics.Accuracy(num_classes=4, average="none"))
+    gen = g_mc_prob(60, 4)
+    args = gen(rng)
+    mine.update(*args)
+    ref.update(*(_to_torch(a) for a in args))
+    out_m, out_r = mine.compute(), ref.compute()
+    assert set(out_m) == set(out_r), set(out_m) ^ set(out_r)
+    for k in out_r:
+        _assert_close(out_m[k], out_r[k], 2e-4, 1e-5, path=f"classwise[{k}]")
 
 
 def test_sweep_is_broad_enough():
