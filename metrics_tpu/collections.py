@@ -34,6 +34,11 @@ class MetricCollection:
         compute_groups: auto-detect metrics with identical states and update
             only one representative per group (True by default), or an explicit
             list of name-groups.
+        on_sync_error / sync_timeout / sync_max_retries / sync_backoff /
+            validate_sync: fault-tolerance policy applied to EVERY member
+            metric at registration (see the :class:`~metrics_tpu.Metric`
+            kwargs of the same names); ``None`` leaves each member's own
+            setting untouched.
 
     Example:
         >>> import jax.numpy as jnp
@@ -54,10 +59,26 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        on_sync_error: Optional[str] = None,
+        sync_timeout: Optional[float] = None,
+        sync_max_retries: Optional[int] = None,
+        sync_backoff: Optional[float] = None,
+        validate_sync: Optional[bool] = None,
     ) -> None:
         self._modules: Dict[str, Metric] = {}
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        if on_sync_error is not None and on_sync_error not in ("raise", "local", "skip"):
+            raise ValueError(
+                f"`on_sync_error` must be 'raise', 'local' or 'skip', got {on_sync_error!r}"
+            )
+        self._sync_policy = {
+            "on_sync_error": on_sync_error,
+            "sync_timeout": sync_timeout,
+            "sync_max_retries": sync_max_retries,
+            "sync_backoff": sync_backoff,
+            "validate_sync": validate_sync,
+        }
         self._enable_compute_groups = compute_groups
         self._groups_checked = False
         self._compute_groups: Dict[int, List[str]] = {}
@@ -87,6 +108,9 @@ class MetricCollection:
         # accumulation must not run underneath it
         metric._flush_pending()
         metric.lazy_updates = 0
+        for key, value in self._sync_policy.items():
+            if value is not None:
+                setattr(metric, key, value)
         self._modules[name] = metric
 
     def add_metrics(
@@ -512,6 +536,15 @@ class MetricCollection:
     @property
     def compute_groups(self) -> Dict[int, List[str]]:
         return self._compute_groups
+
+    @property
+    def last_sync_report(self) -> Dict[str, Optional[Dict[str, Any]]]:
+        """Per-member sync telemetry: ``{name: metric.last_sync_report}``.
+
+        ``None`` entries are members that have not attempted a distributed
+        sync yet.
+        """
+        return {name: m.last_sync_report for name, m in self._modules.items()}
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

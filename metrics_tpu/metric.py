@@ -26,6 +26,8 @@ TPU-first redesign (SURVEY.md §7 delta 1):
 import copy
 import functools
 import numbers
+import os
+import time
 import warnings
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -34,9 +36,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.parallel.backend import Backend, get_backend, reduce_synced_state
+from metrics_tpu.parallel.backend import Backend, SyncOptions, get_backend, reduce_synced_state
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
-from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.exceptions import (
+    MetricsTPUUserError,
+    SyncError,
+    SyncIntegrityError,
+    SyncTimeoutError,
+)
 from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
@@ -137,6 +144,26 @@ class Metric(ABC):
             ``sync``, ``state_dict``, attribute access, pickling) flushes
             first, so results are indistinguishable from immediate updates;
             input validation and mode-locking still run eagerly per call.
+        sync_timeout / sync_max_retries / sync_backoff: fault-tolerance knobs
+            for eager cross-host sync — per-attempt watchdog timeout in
+            seconds, bounded retries, and base backoff sleep (doubled each
+            retry).  ``None`` falls through to the ``METRICS_TPU_SYNC_TIMEOUT``
+            / ``METRICS_TPU_SYNC_MAX_RETRIES`` / ``METRICS_TPU_SYNC_BACKOFF``
+            env vars.  See ``docs/fault_tolerance.md``.
+        on_sync_error: what to do when sync fails with a
+            :class:`~metrics_tpu.utils.exceptions.SyncError` — ``"raise"``
+            (default; env ``METRICS_TPU_ON_SYNC_ERROR``), ``"local"`` (fall
+            back to local unsynced compute with a rank-zero warning), or
+            ``"skip"`` (silent local fallback).
+        validate_sync: check states for NaN/Inf and dtype drift before and
+            after sync, raising
+            :class:`~metrics_tpu.utils.exceptions.SyncIntegrityError` naming
+            the offending state (default off; env
+            ``METRICS_TPU_VALIDATE_SYNC``).
+        sync_backend: explicit :class:`~metrics_tpu.parallel.Backend` to sync
+            through, overriding autodetection — the hook
+            :class:`~metrics_tpu.parallel.ChaosBackend` uses for fault
+            injection.
     """
 
     __jit_state_unsafe__ = False  # set True on metrics whose update cannot trace
@@ -171,6 +198,23 @@ class Metric(ABC):
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         self.donate_state = kwargs.pop("donate_state", True)
         self.lazy_updates = kwargs.pop("lazy_updates", 64)
+        # fault-tolerance knobs (None falls through to METRICS_TPU_SYNC_* env vars)
+        self.sync_timeout = kwargs.pop("sync_timeout", None)
+        self.sync_max_retries = kwargs.pop("sync_max_retries", None)
+        self.sync_backoff = kwargs.pop("sync_backoff", None)
+        self.on_sync_error = kwargs.pop(
+            "on_sync_error", os.environ.get("METRICS_TPU_ON_SYNC_ERROR", "").strip() or "raise"
+        )
+        if self.on_sync_error not in ("raise", "local", "skip"):
+            raise ValueError(
+                f"`on_sync_error` must be 'raise', 'local' or 'skip', got {self.on_sync_error!r}"
+            )
+        self.validate_sync = kwargs.pop(
+            "validate_sync",
+            os.environ.get("METRICS_TPU_VALIDATE_SYNC", "").strip().lower() in ("1", "true", "yes"),
+        )
+        self.sync_backend = kwargs.pop("sync_backend", None)
+        self.last_sync_report: Optional[Dict[str, Any]] = None
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
         # lazy-update accumulator: eager `update` calls append here and flush
@@ -640,39 +684,48 @@ class Metric(ABC):
 
         state = dict(state)
         out: Dict[str, Any] = {}
-        for bname in self._buffer_states:
-            bkey, lkey = bname + "__buf", bname + "__len"
-            if bkey not in state:
-                continue
-            buf, cnt = state.pop(bkey), state.pop(lkey)
-            if isinstance(cnt, jax.core.Tracer):
-                # traced collective (AxisBackend) with dynamic lengths: gather
-                # the padded buffers plus per-device lengths; an eager compute
-                # re-assembles the valid rows afterwards
-                out[bkey] = backend.all_gather_cat(buf)
-                out[lkey] = backend.all_gather_stack(jnp.atleast_1d(jnp.asarray(cnt))).reshape(-1)
-            elif isinstance(buf, jax.core.Tracer):
-                # traced collective, but the count is a trace-time constant —
-                # one program runs on every device, so all lengths equal it;
-                # an int tuple keeps the lengths static and compute can run
-                # fully in-trace
-                out[bkey] = backend.all_gather_cat(buf)
-                out[lkey] = tuple([int(cnt)] * backend.world_size())
-            else:
-                vals = self._extract_buffer_values({bkey: buf, lkey: cnt}, bname)
-                gathered = backend.all_gather_cat(vals)
-                out[bkey] = gathered
-                out[lkey] = int(gathered.shape[0])
-        for name, value in state.items():
-            fx = self._reduce_fns[name]
-            if isinstance(value, list):
-                if not value:
-                    out[name] = value
+        try:
+            for bname in self._buffer_states:
+                bkey, lkey = bname + "__buf", bname + "__len"
+                if bkey not in state:
                     continue
-                value = dim_zero_cat(value)
-                out[name] = backend.all_gather_cat(value)
-            else:
-                out[name] = reduce_synced_state(value, fx, backend)
+                buf, cnt = state.pop(bkey), state.pop(lkey)
+                with backend.annotate(bname):
+                    if isinstance(cnt, jax.core.Tracer):
+                        # traced collective (AxisBackend) with dynamic lengths:
+                        # gather the padded buffers plus per-device lengths; an
+                        # eager compute re-assembles the valid rows afterwards
+                        out[bkey] = backend.all_gather_cat(buf)
+                        out[lkey] = backend.all_gather_stack(
+                            jnp.atleast_1d(jnp.asarray(cnt))
+                        ).reshape(-1)
+                    elif isinstance(buf, jax.core.Tracer):
+                        # traced collective, but the count is a trace-time
+                        # constant — one program runs on every device, so all
+                        # lengths equal it; an int tuple keeps the lengths
+                        # static and compute can run fully in-trace
+                        out[bkey] = backend.all_gather_cat(buf)
+                        out[lkey] = tuple([int(cnt)] * backend.world_size())
+                    else:
+                        vals = self._extract_buffer_values({bkey: buf, lkey: cnt}, bname)
+                        gathered = backend.all_gather_cat(vals)
+                        out[bkey] = gathered
+                        out[lkey] = int(gathered.shape[0])
+            for name, value in state.items():
+                fx = self._reduce_fns[name]
+                with backend.annotate(name):
+                    if isinstance(value, list):
+                        if not value:
+                            out[name] = value
+                            continue
+                        value = dim_zero_cat(value)
+                        out[name] = backend.all_gather_cat(value)
+                    else:
+                        out[name] = reduce_synced_state(value, fx, backend)
+        except SyncTimeoutError as err:
+            # per-state progress: which states HAD completed before the straggler
+            err.synced_states = sorted(k for k in out if not k.endswith("__len"))
+            raise
         return out
 
     # ---------------------------------------------------------------- update
@@ -1358,18 +1411,129 @@ class Metric(ABC):
             if bname + "__buf" in self._state:
                 self._refresh_buffer_meta(bname)
 
+    def _sync_options(self) -> SyncOptions:
+        return SyncOptions.resolve(self.sync_timeout, self.sync_max_retries, self.sync_backoff)
+
+    def _schema_entries(self) -> List[Tuple[str, str]]:
+        """``(state_name, signature)`` pairs for the pre-flight digest exchange.
+
+        Signatures capture exactly what must agree across ranks for the gather
+        to be well-formed: trailing (per-row) shape + dtype for cat/list/buffer
+        states, whose leading dim legitimately differs with shard size, and
+        the full shape + dtype for reduced tensor states.
+        """
+        entries: List[Tuple[str, str]] = []
+        handled: set = set()
+        for bname, meta in self._buffer_states.items():
+            bkey, lkey = bname + "__buf", bname + "__len"
+            if bkey not in self._state:
+                continue
+            handled.update((bkey, lkey))
+            trail = meta.get("trail")
+            sig = f"buffer:{tuple(trail) if trail is not None else '?'}:{meta.get('dtype')}"
+            entries.append((bname, sig))
+        for name, value in self._state.items():
+            if name in handled:
+                continue
+            fx = self._reduce_fns.get(name)
+            if isinstance(value, list):
+                if value:
+                    head = jnp.asarray(value[0])
+                    sig = f"list:{tuple(head.shape[1:])}:{head.dtype}"
+                else:
+                    # one empty and one non-empty rank would deadlock the cat
+                    # gather, so emptiness is part of the signature
+                    sig = "list:empty"
+            else:
+                arr = jnp.asarray(value)
+                if fx == "cat" or fx is None:
+                    sig = f"cat:{tuple(arr.shape[1:])}:{arr.dtype}"
+                else:
+                    fxn = fx if isinstance(fx, str) else getattr(fx, "__name__", "custom")
+                    sig = f"{fxn}:{tuple(arr.shape)}:{arr.dtype}"
+            entries.append((name, sig))
+        return entries
+
+    def _validate_state_integrity(
+        self, state: Dict[str, Any], phase: str, reference: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """NaN/Inf + dtype-drift checks for ``validate_sync=True`` (eager only)."""
+        import jax.core
+
+        for name, value in state.items():
+            if name.endswith("__len"):
+                continue
+            leaves = value if isinstance(value, list) else [value]
+            for leaf in leaves:
+                if leaf is None or isinstance(leaf, (int, tuple, jax.core.Tracer)):
+                    continue
+                arr = jnp.asarray(leaf)
+                if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(jnp.isfinite(arr).all()):
+                    raise SyncIntegrityError(
+                        f"metric state {name!r} of {type(self).__name__} holds non-finite "
+                        f"values {phase}; a peer contributed NaN/Inf or the payload was "
+                        "corrupted in flight",
+                        state=name,
+                        phase=phase,
+                        problem="non-finite values",
+                    )
+            if reference is not None and name in reference:
+                ref = reference[name]
+                ref_leaf = ref[0] if isinstance(ref, list) and ref else ref
+                new_leaf = value[0] if isinstance(value, list) and value else value
+                if hasattr(ref_leaf, "dtype") and hasattr(new_leaf, "dtype"):
+                    old_dt, new_dt = jnp.asarray(ref_leaf).dtype, jnp.asarray(new_leaf).dtype
+                    if old_dt != new_dt:
+                        raise SyncIntegrityError(
+                            f"metric state {name!r} of {type(self).__name__} drifted from "
+                            f"dtype {old_dt} to {new_dt} through sync",
+                            state=name,
+                            phase=phase,
+                            problem=f"dtype drift {old_dt} -> {new_dt}",
+                        )
+
+    def _finish_sync_report(
+        self, report: Dict[str, Any], backend: Backend, start: float
+    ) -> None:
+        report["duration_secs"] = round(time.perf_counter() - start, 6)
+        tel = backend.pop_telemetry() or {}
+        report["retries"] = int(tel.pop("retries", 0))
+        report["gather_calls"] = int(tel.pop("gather_calls", 0))
+        report["bytes_gathered"] = int(tel.pop("bytes_gathered", 0))
+        report.update(tel)
+        self.last_sync_report = report
+
     def sync(
         self,
         dist_sync_fn: Optional[Callable] = None,
         should_sync: bool = True,
         distributed_available: Optional[bool] = None,
+        backend: Optional[Backend] = None,
     ) -> None:
-        """Gather + reduce state across participants (reference ``metric.py:408-442``)."""
+        """Gather + reduce state across participants (reference ``metric.py:408-442``).
+
+        On the eager cross-host path this is fault-tolerant: a pre-flight
+        schema digest exchange turns a diverged peer into
+        :class:`SyncDesyncError`, every collective runs under the watchdog +
+        retry policy of :meth:`_sync_options`, and failures are handled per
+        ``on_sync_error`` (``"local"``/``"skip"`` keep the cached local state
+        so compute stays live).  Each attempt records ``last_sync_report``.
+        """
         if self._is_synced:
             raise MetricsTPUUserError("The Metric has already been synced.")
         self._flush_pending()
         self._flush_host_buffers()
-        backend = get_backend(self.axis_name)
+        if backend is None:
+            backend = self.sync_backend
+        if backend is None:
+            backend = get_backend(self.axis_name, self._sync_options())
+        elif hasattr(backend, "options") and (
+            self.sync_timeout is not None
+            or self.sync_max_retries is not None
+            or self.sync_backoff is not None
+        ):
+            # per-metric knobs take precedence over the injected backend's own
+            backend.options = self._sync_options()
         if distributed_available is None:
             distributed_available = backend.is_distributed()
         self._cache = self._copy_state()
@@ -1377,13 +1541,44 @@ class Metric(ABC):
         if not should_sync or not distributed_available:
             self._is_synced = True
             return
-        dist_sync_fn = dist_sync_fn or self.dist_sync_fn
-        if dist_sync_fn is not None:
-            new_state = dist_sync_fn(self._copy_state(), dict(self._reduce_fns), backend)
-        else:
-            new_state = self._sync_state_pure(self._state, backend)
-        self._state.update(new_state)
-        self._is_synced = True
+        report: Dict[str, Any] = {
+            "backend": type(backend).__name__,
+            "world_size": int(backend.world_size()) if backend.eager else None,
+            "fallback": None,
+            "error": None,
+        }
+        start = time.perf_counter()
+        try:
+            if backend.eager:
+                if self.validate_sync:
+                    self._validate_state_integrity(self._state, "pre-sync")
+                info = backend.preflight_check(self._schema_entries(), self._update_count)
+                if info:
+                    report.update(info)
+            dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+            if dist_sync_fn is not None:
+                new_state = dist_sync_fn(self._copy_state(), dict(self._reduce_fns), backend)
+            else:
+                new_state = self._sync_state_pure(self._state, backend)
+            if backend.eager and self.validate_sync:
+                self._validate_state_integrity(new_state, "post-sync", reference=self._cache)
+            self._state.update(new_state)
+            self._is_synced = True
+        except SyncError as err:
+            report["error"] = f"{type(err).__name__}: {err}"
+            if self.on_sync_error == "raise":
+                self._finish_sync_report(report, backend, start)
+                raise
+            report["fallback"] = "local"
+            if self.on_sync_error == "local":
+                rank_zero_warn(
+                    f"Metric {type(self).__name__} sync failed ({type(err).__name__}: {err}); "
+                    "falling back to local unsynced state on this rank.",
+                    UserWarning,
+                )
+            self._restore_state(self._cache)
+            self._is_synced = True
+        self._finish_sync_report(report, backend, start)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore the pre-sync local state (reference ``metric.py:444-464``)."""

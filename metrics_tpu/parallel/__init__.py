@@ -5,19 +5,30 @@ from metrics_tpu.parallel.backend import (
     Backend,
     MultihostBackend,
     NullBackend,
+    SyncOptions,
     axis_context,
     current_axis,
+    find_schema_divergence,
     get_backend,
+    guarded_collective,
     reduce_synced_state,
+    schema_digest_rows,
 )
+from metrics_tpu.parallel.faults import ChaosBackend, ChaosInjectedError
 
 __all__ = [
     "AxisBackend",
     "Backend",
+    "ChaosBackend",
+    "ChaosInjectedError",
     "MultihostBackend",
     "NullBackend",
+    "SyncOptions",
     "axis_context",
     "current_axis",
+    "find_schema_divergence",
     "get_backend",
+    "guarded_collective",
     "reduce_synced_state",
+    "schema_digest_rows",
 ]

@@ -22,12 +22,21 @@ map onto collectives 1:1: ``sum→psum, mean→pmean, max→pmax, min→pmin,
 cat→all_gather(tiled)``.
 """
 
+import dataclasses
+import hashlib
+import itertools
+import os
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Union
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+
+from metrics_tpu.utils.exceptions import SyncDesyncError, SyncError, SyncTimeoutError
 
 Array = jax.Array
 
@@ -69,8 +78,216 @@ def current_axis() -> Optional[Union[str, Sequence[str]]]:
     return stack[-1] if stack else None
 
 
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncOptions:
+    """Fault-tolerance knobs for eager (cross-host / DCN) collectives.
+
+    ``timeout`` is per collective attempt in seconds (``None`` disables the
+    watchdog); ``max_retries`` bounds re-attempts after a timeout or a
+    transient collective error; ``backoff`` is the base sleep between
+    attempts (doubled each retry).  Environment defaults:
+    ``METRICS_TPU_SYNC_TIMEOUT`` / ``METRICS_TPU_SYNC_MAX_RETRIES`` /
+    ``METRICS_TPU_SYNC_BACKOFF``.
+    """
+
+    timeout: Optional[float] = None
+    max_retries: int = 0
+    backoff: float = 0.5
+
+    @classmethod
+    def from_env(cls) -> "SyncOptions":
+        timeout = _env_float("METRICS_TPU_SYNC_TIMEOUT")
+        retries = _env_float("METRICS_TPU_SYNC_MAX_RETRIES")
+        backoff = _env_float("METRICS_TPU_SYNC_BACKOFF")
+        return cls(
+            timeout=timeout,
+            max_retries=int(retries) if retries is not None else 0,
+            backoff=backoff if backoff is not None else 0.5,
+        )
+
+    @classmethod
+    def resolve(
+        cls,
+        timeout: Optional[float] = None,
+        max_retries: Optional[int] = None,
+        backoff: Optional[float] = None,
+    ) -> "SyncOptions":
+        """Explicit values override env defaults; ``None`` falls through."""
+        env = cls.from_env()
+        return cls(
+            timeout=timeout if timeout is not None else env.timeout,
+            max_retries=int(max_retries) if max_retries is not None else env.max_retries,
+            backoff=backoff if backoff is not None else env.backoff,
+        )
+
+
+class _WatchdogTimeout(Exception):
+    """Internal marker: the guarded call's worker thread missed the deadline."""
+
+
+def _call_with_deadline(fn: Callable[[], Any], timeout: Optional[float], label: str) -> Any:
+    """Run ``fn`` on a watchdog thread; raise ``_WatchdogTimeout`` past the deadline.
+
+    A DCN collective is a blocking native call that cannot be interrupted, so
+    on timeout the worker thread is abandoned (daemon — it cannot keep the
+    process alive).  The caller gets control back with diagnostics instead of
+    a silent cluster-wide hang.
+    """
+    if timeout is None:
+        return fn()
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def runner() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 — must cross the thread
+            box["error"] = err
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True, name=f"mtpu-sync[{label}]")
+    t.start()
+    if not done.wait(timeout):
+        raise _WatchdogTimeout(label)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def guarded_collective(
+    fn: Callable[[], Any],
+    options: SyncOptions,
+    label: str = "collective",
+    telemetry: Optional[Dict[str, Any]] = None,
+) -> Any:
+    """Execute one collective under the timeout + bounded retry/backoff policy.
+
+    Timeouts raise :class:`SyncTimeoutError` after the retry budget is spent;
+    transient exceptions from the collective are retried the same way and the
+    ORIGINAL error re-raised when the budget runs out (a genuine failure must
+    not be masked as a timeout).  :class:`SyncError` subclasses raised by
+    ``fn`` itself (e.g. an injected desync) propagate immediately — they are
+    verdicts, not transient conditions.
+    """
+    attempts = max(int(options.max_retries), 0) + 1
+    start = time.perf_counter()
+    last_error: Optional[BaseException] = None
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(options.backoff * (2 ** (attempt - 1)))
+        try:
+            value = _call_with_deadline(fn, options.timeout, label)
+        except SyncError:
+            raise
+        except _WatchdogTimeout:
+            last_error = None
+            continue
+        except Exception as err:  # transient collective error: retry, then re-raise
+            last_error = err
+            continue
+        if telemetry is not None and attempt:
+            telemetry["retries"] = telemetry.get("retries", 0) + attempt
+        return value
+    if telemetry is not None:
+        telemetry["retries"] = telemetry.get("retries", 0) + attempts - 1
+    if last_error is not None:
+        raise last_error
+    elapsed = time.perf_counter() - start
+    raise SyncTimeoutError(
+        f"collective {label!r} timed out after {attempts} attempt(s) x "
+        f"{options.timeout}s ({elapsed:.2f}s elapsed); a peer is stalled or gone",
+        state=label,
+        timeout=options.timeout,
+        attempts=attempts,
+    )
+
+
+def schema_digest_rows(entries: Sequence[Tuple[str, str]]) -> np.ndarray:
+    """Fixed-size per-state digests of ``(name, signature)`` pairs.
+
+    Returns a ``(S, 16)`` uint8 array — a constant-shape payload that can be
+    all-gathered safely even when the underlying states have diverged.
+    """
+    rows = np.zeros((len(entries), 16), np.uint8)
+    for i, (name, sig) in enumerate(entries):
+        h = hashlib.blake2b(f"{name}|{sig}".encode(), digest_size=16)
+        rows[i] = np.frombuffer(h.digest(), np.uint8)
+    return rows
+
+
+def find_schema_divergence(
+    gathered: np.ndarray, my_rank: int
+) -> Optional[Tuple[int, int]]:
+    """First ``(rank, state_index)`` whose digest differs from ours, else None.
+
+    ``gathered`` is the ``(P, S, 16)`` stacked digest exchange.
+    """
+    mine = gathered[my_rank]
+    for rank in range(gathered.shape[0]):
+        if rank == my_rank:
+            continue
+        diff = np.nonzero((gathered[rank] != mine).any(axis=-1))[0]
+        if diff.size:
+            return rank, int(diff[0])
+    return None
+
+
+#: shared collective sequence numbers for the coordination-service gather
+#: transport; advances identically on every rank because the sync protocol
+#: is SPMD (same collectives, same order)
+_KV_SEQ = itertools.count()
+
+
 class Backend:
     """Protocol for metric-state synchronization."""
+
+    #: eager backends run host-side Python between collectives, so the
+    #: fault-tolerance layer (preflight digests, watchdog timeouts, state
+    #: validation) can act; in-trace backends (AxisBackend) cannot — a shape
+    #: mismatch there fails loudly at trace time anyway.
+    eager: bool = True
+
+    #: label set by the caller (the metric's per-state sync loop) so timeout
+    #: diagnostics and telemetry can name the state being gathered
+    _label: Optional[str] = None
+
+    @contextmanager
+    def annotate(self, label: Optional[str]):
+        """Attribute the collectives issued inside the block to ``label``."""
+        prev = self._label
+        self._label = label
+        try:
+            yield self
+        finally:
+            self._label = prev
+
+    def preflight_check(
+        self, entries: Sequence[Tuple[str, str]], update_count: int = 0
+    ) -> Optional[Dict[str, Any]]:
+        """Schema-agreement check before any state gather.
+
+        ``entries`` are ``(state_name, signature)`` pairs.  Distributed eager
+        backends exchange fixed-size digests and raise
+        :class:`SyncDesyncError` naming the diverging rank and state;
+        non-distributed / in-trace backends are no-ops.  Returns optional
+        info (e.g. peer update counts) for telemetry.
+        """
+        return None
+
+    def pop_telemetry(self) -> Optional[Dict[str, Any]]:
+        """Return and reset collective-level telemetry, if the backend keeps any."""
+        return None
 
     def is_distributed(self) -> bool:
         raise NotImplementedError
@@ -126,7 +343,14 @@ class NullBackend(Backend):
 
 
 class AxisBackend(Backend):
-    """lax collectives over a named mesh axis (inside shard_map/pmap)."""
+    """lax collectives over a named mesh axis (inside shard_map/pmap).
+
+    In-trace: the fault-tolerance layer stands down here — collectives are
+    compiled into one SPMD program, so a schema mismatch fails loudly at
+    trace time and there is no host boundary for a watchdog to guard.
+    """
+
+    eager = False
 
     def __init__(self, axis_name: Union[str, Sequence[str]]):
         self.axis_name = axis_name
@@ -138,7 +362,8 @@ class AxisBackend(Backend):
         names = self.axis_name if isinstance(self.axis_name, (tuple, list)) else (self.axis_name,)
         size = 1
         for n in names:
-            size *= lax.axis_size(n)
+            # lax.axis_size is jax>=0.5; psum of a python 1 stays static
+            size *= lax.axis_size(n) if hasattr(lax, "axis_size") else lax.psum(1, n)
         return size
 
     def psum(self, x):
@@ -162,7 +387,22 @@ class AxisBackend(Backend):
 
 
 class MultihostBackend(Backend):
-    """Eager cross-host sync over DCN (one JAX process per host)."""
+    """Eager cross-host sync over DCN (one JAX process per host).
+
+    Every collective runs through :func:`guarded_collective`: a watchdog
+    thread enforces ``options.timeout`` so a stalled or dead peer raises
+    :class:`SyncTimeoutError` instead of hanging the fleet, with bounded
+    retry/backoff for transient failures.  Per-sync telemetry (gather count,
+    bytes, retries) accumulates until :meth:`pop_telemetry`.
+    """
+
+    def __init__(self, options: Optional[SyncOptions] = None):
+        self.options = options if options is not None else SyncOptions.from_env()
+        self._telemetry: Dict[str, Any] = {}
+
+    def pop_telemetry(self) -> Optional[Dict[str, Any]]:
+        out, self._telemetry = self._telemetry, {}
+        return out
 
     def is_distributed(self) -> bool:
         return jax.process_count() > 1
@@ -170,11 +410,141 @@ class MultihostBackend(Backend):
     def world_size(self) -> int:
         return jax.process_count()
 
+    def rank(self) -> int:
+        return jax.process_index()
+
+    #: tri-state probe shared by all instances: ``None`` = unprobed, ``True``
+    #: = this platform's XLA cannot run cross-process computations (CPU
+    #: backends) and the coordination-service transport is in use instead
+    _xla_collectives_broken: Optional[bool] = None
+
     def _gather(self, x: Array) -> Array:
         """Stacked cross-process gather: returns ``(P,) + x.shape``."""
+        x = jnp.asarray(x)
+        label = self._label or "gather"
+        seq = next(_KV_SEQ)  # fixed per LOGICAL collective: retries reuse it
+        out = guarded_collective(
+            lambda: self._allgather(x, seq),
+            self.options,
+            label=label,
+            telemetry=self._telemetry,
+        )
+        self._telemetry["gather_calls"] = self._telemetry.get("gather_calls", 0) + 1
+        nbytes = getattr(out, "nbytes", 0)
+        self._telemetry["bytes_gathered"] = self._telemetry.get("bytes_gathered", 0) + int(nbytes)
+        return out
+
+    def _allgather(self, x: Array, seq: int) -> Any:
         from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(jnp.asarray(x))
+        cls = MultihostBackend
+        if cls._xla_collectives_broken is None:
+            try:
+                out = multihost_utils.process_allgather(x)
+                cls._xla_collectives_broken = False
+                return out
+            except Exception as err:  # jaxlib raises a plain XlaRuntimeError
+                if "Multiprocess computations aren't implemented" not in str(err):
+                    raise
+                cls._xla_collectives_broken = True
+        if cls._xla_collectives_broken:
+            return self._kv_allgather(x, seq)
+        return multihost_utils.process_allgather(x)
+
+    def _kv_allgather(self, x: Array, seq: int) -> Any:
+        """Cross-process gather over the ``jax.distributed`` coordination
+        service — the degraded transport for platforms whose XLA backend
+        cannot launch multiprocess computations (CPU: "Multiprocess
+        computations aren't implemented").
+
+        Each process publishes its payload under a sequence-numbered key and
+        blocks on every peer's.  The metric sync protocol is SPMD — every
+        rank issues the same collectives in the same order — so the shared
+        monotonic sequence number is enough to match payloads; a rank that
+        never publishes (stalled/dead peer) parks the read until the
+        watchdog above converts it into :class:`SyncTimeoutError`.
+        """
+        import io
+
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "cross-process sync needs jax.distributed.initialize() on this platform"
+            )
+        me, world = self.rank(), self.world_size()
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(x), allow_pickle=False)
+        try:
+            client.key_value_set_bytes(f"mtpu/ag/{seq}/{me}", buf.getvalue())
+        except Exception:
+            pass  # retry of the same collective: our payload is already up
+        # the guard owns timeout semantics; the store read only needs a
+        # longer backstop so an unguarded sync cannot hang forever
+        backstop_ms = int(1000 * (self.options.timeout * 4 if self.options.timeout else 600.0))
+        parts = [
+            np.load(
+                io.BytesIO(
+                    client.blocking_key_value_get_bytes(f"mtpu/ag/{seq}/{r}", backstop_ms)
+                ),
+                allow_pickle=False,
+            )
+            for r in range(world)
+        ]
+        if seq >= 2:
+            # our previous gather returned, so every peer published seq-1,
+            # which required them to finish reading all seq-2 payloads —
+            # nobody can still need ours
+            try:
+                client.key_value_delete(f"mtpu/ag/{seq - 2}/{me}")
+            except Exception:
+                pass
+        return np.stack(parts)
+
+    def preflight_check(
+        self, entries: Sequence[Tuple[str, str]], update_count: int = 0
+    ) -> Optional[Dict[str, Any]]:
+        """Exchange tiny per-state metadata digests BEFORE any state gather.
+
+        Two fixed-shape collectives (a scalar count, then ``(S, 16)`` digest
+        rows) — always gatherable no matter how far the peers diverged.  A
+        registry-size or per-state signature mismatch raises
+        :class:`SyncDesyncError` naming the diverging rank and state; the
+        update counts ride along for telemetry (unequal counts are legal —
+        uneven data shards — so they warn upstream rather than fail here).
+        """
+        if not self.is_distributed():
+            return None
+        me = self.rank()
+        with self.annotate("preflight/schema"):
+            meta = np.asarray(
+                self._gather(jnp.asarray([len(entries), int(update_count)], jnp.int32))
+            ).reshape(-1, 2)
+        counts = meta[:, 0]
+        if not (counts == counts[me]).all():
+            bad = int(np.nonzero(counts != counts[me])[0][0])
+            raise SyncDesyncError(
+                f"metric state registry size diverged before sync: rank {bad} "
+                f"registers {int(counts[bad])} sync state(s), rank {me} has "
+                f"{len(entries)} — the peers are not running the same metric",
+                rank=bad,
+            )
+        if entries:
+            with self.annotate("preflight/digests"):
+                gathered = np.asarray(self._gather(jnp.asarray(schema_digest_rows(entries))))
+            div = find_schema_divergence(gathered, me)
+            if div is not None:
+                rank, idx = div
+                name, sig = entries[idx]
+                raise SyncDesyncError(
+                    f"metric state {name!r} diverged on rank {rank} before sync "
+                    f"(local signature {sig!r}); gathering it would hang or "
+                    "miscompile every rank",
+                    rank=rank,
+                    state=name,
+                )
+        return {"peer_update_counts": [int(c) for c in meta[:, 1]]}
 
     def psum(self, x):
         return jnp.sum(self._gather(x), axis=0)
@@ -207,13 +577,20 @@ class MultihostBackend(Backend):
         return jnp.concatenate([gathered[p, : sizes[p]] for p in range(len(sizes))], axis=0)
 
 
-def get_backend(axis_name: Optional[Union[str, Sequence[str]]] = None) -> Backend:
-    """Innermost active backend: explicit axis > ambient axis_context > multihost > null."""
+def get_backend(
+    axis_name: Optional[Union[str, Sequence[str]]] = None,
+    options: Optional[SyncOptions] = None,
+) -> Backend:
+    """Innermost active backend: explicit axis > ambient axis_context > multihost > null.
+
+    ``options`` carries the fault-tolerance knobs (timeout/retry/backoff) to
+    the eager cross-host backend; the in-trace and null tiers ignore it.
+    """
     axis = axis_name if axis_name is not None else current_axis()
     if axis is not None:
         return AxisBackend(axis)
     if jax.process_count() > 1:
-        return MultihostBackend()
+        return MultihostBackend(options)
     return NullBackend()
 
 

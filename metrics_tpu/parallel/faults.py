@@ -1,0 +1,250 @@
+"""Chaos-injection backend: deterministic fault schedules for sync testing.
+
+Production eval fleets lose hosts mid-epoch, corrupt payloads on flaky links,
+and desynchronize when a straggler restarts with different code.  None of
+those scenarios can be provoked on demand in CPU-only CI with real
+collectives — so :class:`ChaosBackend` wraps ANY :class:`Backend` and injects
+them from a seeded deterministic schedule:
+
+* ``delay`` — sleep before the collective (trips the watchdog when the sleep
+  exceeds ``sync_timeout``; with retries, a single scheduled delay yields the
+  retry-then-succeed path).
+* ``drop`` — the collective never completes (simulated dead peer: the call
+  parks on an event until the watchdog gives up).
+* ``corrupt`` — the collective completes but its float payload is NaN-poisoned
+  (caught by ``validate_sync=True``).
+* ``error`` — the collective raises a transient ``ChaosInjectedError``
+  (exercises retry/backoff).
+* ``desync`` — the pre-flight schema exchange sees a diverged peer
+  (exercises :class:`SyncDesyncError` naming rank and state).
+
+Faults are consumed one-shot: a retry of the same collective re-executes
+WITHOUT the fault, so ``schedule={0: "delay"}`` + ``max_retries=1`` is the
+canonical recover-after-straggle test.
+
+Usage::
+
+    chaos = ChaosBackend(NullBackend(), schedule={0: ("delay", 1.0)}, world_size=2)
+    metric = Accuracy(..., sync_backend=chaos, sync_timeout=0.2, sync_max_retries=1)
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.backend import (
+    Backend,
+    SyncOptions,
+    find_schema_divergence,
+    guarded_collective,
+    schema_digest_rows,
+)
+from metrics_tpu.utils.exceptions import SyncDesyncError
+
+FaultSpec = Union[str, Tuple[str, Any]]
+
+_FAULT_KINDS = ("delay", "drop", "corrupt", "error", "desync")
+
+
+class ChaosInjectedError(RuntimeError):
+    """Transient failure injected by :class:`ChaosBackend` (retryable)."""
+
+
+def _nan_poison(value: Any) -> Any:
+    """Overwrite the first element of every float array leaf with NaN."""
+    import jax
+
+    def poison(leaf: Any) -> Any:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            arr = np.asarray(leaf).copy()
+            if arr.size:
+                arr.reshape(-1)[0] = np.nan
+            return jnp.asarray(arr)
+        return leaf
+
+    return jax.tree_util.tree_map(poison, value)
+
+
+class ChaosBackend(Backend):
+    """Fault-injection wrapper around any :class:`Backend`.
+
+    Args:
+        inner: the real backend every collective delegates to.
+        schedule: explicit deterministic schedule — ``{collective_index:
+            fault}`` where fault is a kind string or ``(kind, arg)``
+            (``("delay", secs)``, ``("drop", secs)``).  Collective indices
+            count every psum/pmean/pmax/pmin/gather/preflight call on this
+            instance, in order.
+        seed / fault_probs: probabilistic mode — each collective draws from
+            ``np.random.default_rng(seed)``; given the same seed and call
+            order the injected faults are fully deterministic.
+        world_size: simulated world size when ``inner`` is not distributed
+            (lets single-process CI exercise the multi-rank failure paths;
+            collectives still return inner's local values).
+        delay_secs / drop_secs: default durations for ``delay`` / ``drop``.
+        options: guard options for the chaos layer itself when the inner
+            backend has none (e.g. a NullBackend inner); a MultihostBackend
+            inner keeps its own guard.
+    """
+
+    def __init__(
+        self,
+        inner: Backend,
+        schedule: Optional[Dict[int, FaultSpec]] = None,
+        seed: int = 0,
+        fault_probs: Optional[Dict[str, float]] = None,
+        world_size: Optional[int] = None,
+        delay_secs: float = 0.05,
+        drop_secs: float = 60.0,
+        options: Optional[SyncOptions] = None,
+    ):
+        self.inner = inner
+        self.schedule = dict(schedule or {})
+        for fault in self.schedule.values():
+            kind = fault[0] if isinstance(fault, tuple) else fault
+            if kind not in _FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; expected one of {_FAULT_KINDS}")
+        self.fault_probs = dict(fault_probs or {})
+        self._rng = np.random.default_rng(seed)
+        self._world = world_size
+        self.delay_secs = delay_secs
+        self.drop_secs = drop_secs
+        self.options = options if options is not None else SyncOptions.from_env()
+        self.op_index = 0
+        self.injected: list = []  # (op_index, kind) log for assertions
+        self._telemetry: Dict[str, Any] = {}
+        self._drop_event = threading.Event()  # never set: a drop parks here
+
+    # ------------------------------------------------------------- scheduling
+    def _next_fault(self) -> Tuple[int, Optional[str], Any]:
+        idx = self.op_index
+        self.op_index += 1
+        fault = self.schedule.pop(idx, None)
+        if fault is None and self.fault_probs:
+            draw = self._rng.random()
+            edge = 0.0
+            for kind, prob in self.fault_probs.items():
+                edge += prob
+                if draw < edge:
+                    fault = kind
+                    break
+        if fault is None:
+            return idx, None, None
+        kind, arg = (fault if isinstance(fault, tuple) else (fault, None))
+        self.injected.append((idx, kind))
+        return idx, kind, arg
+
+    def _run(self, op: str, fn: Callable[[], Any]) -> Any:
+        idx, kind, arg = self._next_fault()
+        return self._guarded(op, fn, idx, kind, arg)
+
+    def _guarded(self, op: str, fn: Callable[[], Any], idx: int, kind: Optional[str], arg: Any) -> Any:
+        consumed = {"pending": kind}
+
+        def faulted() -> Any:
+            # one-shot: the first attempt pays the fault, a retry runs clean
+            k, consumed["pending"] = consumed["pending"], None
+            if k == "delay":
+                time.sleep(arg if arg is not None else self.delay_secs)
+            elif k == "drop":
+                self._drop_event.wait(arg if arg is not None else self.drop_secs)
+                raise ChaosInjectedError(f"collective #{idx} ({op}) dropped by chaos schedule")
+            elif k == "error":
+                raise ChaosInjectedError(f"collective #{idx} ({op}) failed by chaos schedule")
+            out = fn()
+            if k == "corrupt":
+                out = _nan_poison(out)
+            return out
+
+        label = self._label or op
+        return guarded_collective(faulted, self.options, label=label, telemetry=self._telemetry)
+
+    # ---------------------------------------------------------------- protocol
+    def is_distributed(self) -> bool:
+        return self.inner.is_distributed() or (self._world or 1) > 1
+
+    def world_size(self) -> int:
+        if self._world is not None:
+            return self._world
+        return self.inner.world_size()
+
+    def rank(self) -> int:
+        return getattr(self.inner, "rank", lambda: 0)()
+
+    def pop_telemetry(self) -> Optional[Dict[str, Any]]:
+        out, self._telemetry = self._telemetry, {}
+        inner = self.inner.pop_telemetry()
+        for key, val in (inner or {}).items():
+            out[key] = out.get(key, 0) + val
+        out["faults_injected"] = len(self.injected)
+        return out
+
+    def preflight_check(
+        self, entries: Sequence[Tuple[str, str]], update_count: int = 0
+    ) -> Optional[Dict[str, Any]]:
+        idx, kind, arg = self._next_fault()
+        if kind == "desync":
+            state_idx = int(arg) if arg is not None else 0
+            if entries and self.inner.is_distributed():
+                # real peers: perturb OUR digest so the genuine exchange
+                # detects this rank as the diverged one on every peer
+                entries = list(entries)
+                name, sig = entries[min(state_idx, len(entries) - 1)]
+                entries[min(state_idx, len(entries) - 1)] = (name, sig + "|chaos-desync")
+                return self.inner.preflight_check(entries, update_count)
+            # single-process: simulate the exchange — peer (world-1) diverges
+            world = max(self.world_size(), 2)
+            rows = schema_digest_rows(entries)
+            if not len(entries):
+                raise SyncDesyncError(
+                    f"metric state registry size diverged before sync: rank {world - 1} "
+                    f"registers 1 sync state(s), rank 0 has 0",
+                    rank=world - 1,
+                )
+            gathered = np.stack([rows] * world)
+            peer = schema_digest_rows(
+                [
+                    (n, s + "|chaos-desync") if i == min(state_idx, len(entries) - 1) else (n, s)
+                    for i, (n, s) in enumerate(entries)
+                ]
+            )
+            gathered[world - 1] = peer
+            div = find_schema_divergence(gathered, 0)
+            assert div is not None
+            rank, sidx = div
+            name, sig = entries[sidx]
+            raise SyncDesyncError(
+                f"metric state {name!r} diverged on rank {rank} before sync "
+                f"(local signature {sig!r}); gathering it would hang or "
+                "miscompile every rank",
+                rank=rank,
+                state=name,
+            )
+        if kind is not None:
+            # non-desync faults apply to the underlying exchange collectives
+            return self._guarded(
+                "preflight", lambda: self.inner.preflight_check(entries, update_count), idx, kind, arg
+            )
+        return self.inner.preflight_check(entries, update_count)
+
+    # ------------------------------------------------------------- collectives
+    def psum(self, x):
+        return self._run("psum", lambda: self.inner.psum(x))
+
+    def pmean(self, x):
+        return self._run("pmean", lambda: self.inner.pmean(x))
+
+    def pmax(self, x):
+        return self._run("pmax", lambda: self.inner.pmax(x))
+
+    def pmin(self, x):
+        return self._run("pmin", lambda: self.inner.pmin(x))
+
+    def all_gather_cat(self, x):
+        return self._run("all_gather_cat", lambda: self.inner.all_gather_cat(x))
+
+    def all_gather_stack(self, x):
+        return self._run("all_gather_stack", lambda: self.inner.all_gather_stack(x))

@@ -1,5 +1,7 @@
 """Exceptions (reference ``utilities/exceptions.py``)."""
 
+from typing import Optional, Sequence
+
 
 class MetricsTPUUserError(Exception):
     """Error raised on wrong usage of the metrics API."""
@@ -7,3 +9,80 @@ class MetricsTPUUserError(Exception):
 
 # alias kept for drop-in familiarity with the reference name
 TorchMetricsUserError = MetricsTPUUserError
+
+
+class SyncError(Exception):
+    """Base class for distributed metric-state synchronization failures.
+
+    Every failure the fault-tolerance layer can detect (schema desync,
+    straggler timeout, state corruption) derives from this type, so the
+    ``on_sync_error`` policy on :class:`~metrics_tpu.Metric` has one stable
+    thing to catch.  Genuine programming errors (bad arguments, trace
+    failures) deliberately do NOT derive from it and always propagate.
+    """
+
+
+class SyncDesyncError(SyncError):
+    """Raised by the pre-flight schema-agreement check when a peer's metric
+    state registry diverges (different state names, shapes, or dtypes).
+
+    Without the check, a shape-diverged peer makes ``process_allgather``
+    miscompile or hang every rank; with it, each rank raises eagerly with the
+    diverging rank and state named.
+
+    Attributes:
+        rank: the first diverging peer rank (``None`` when the divergence is
+            a registry-size mismatch attributable to several ranks).
+        state: the name of the first diverging state (``None`` for
+            registry-size mismatches).
+    """
+
+    def __init__(self, message: str, *, rank: Optional[int] = None, state: Optional[str] = None):
+        super().__init__(message)
+        self.rank = rank
+        self.state = state
+
+
+class SyncTimeoutError(SyncError):
+    """Raised when a collective does not complete within ``sync_timeout``.
+
+    Attributes:
+        state: the metric state being gathered when the watchdog fired.
+        timeout: the per-attempt timeout in seconds.
+        attempts: how many attempts (1 + retries) were made.
+        synced_states: names of the states that HAD completed their
+            collectives before the straggler — the per-state progress info.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        state: Optional[str] = None,
+        timeout: Optional[float] = None,
+        attempts: int = 1,
+        synced_states: Optional[Sequence[str]] = None,
+    ):
+        super().__init__(message)
+        self.state = state
+        self.timeout = timeout
+        self.attempts = attempts
+        self.synced_states = list(synced_states or [])
+
+
+class SyncIntegrityError(SyncError):
+    """Raised by ``validate_sync=True`` when a pre- or post-sync state holds
+    NaN/Inf values or drifted to a different dtype through the collective.
+
+    Attributes:
+        state: the offending state's name.
+        phase: ``"pre-sync"`` or ``"post-sync"``.
+        problem: short description (``"non-finite values"``, ``"dtype drift
+            float32 -> float64"``).
+    """
+
+    def __init__(self, message: str, *, state: str, phase: str, problem: str):
+        super().__init__(message)
+        self.state = state
+        self.phase = phase
+        self.problem = problem

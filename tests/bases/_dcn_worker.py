@@ -1,20 +1,107 @@
 """Worker process for the real two-process DCN sync test.
 
 Launched by ``tests/bases/test_ddp.py::test_multihost_two_process_real`` as
-``python _dcn_worker.py <rank> <nproc> <port>``.  Initializes
+``python _dcn_worker.py <rank> <nproc> <port> [scenario]``.  Initializes
 ``jax.distributed`` (CPU, gloo-backed collectives over localhost — the TPU
 translation of the reference's spawned gloo process groups,
 ``tests/unittests/bases/test_ddp.py:63-81``) and runs metric sync end-to-end
 through ``Metric.compute()`` on the MultihostBackend, including the
 uneven-shard gather-sizes → pad → gather → trim path.
+
+Fault scenarios (real two-process failure modes, not ChaosBackend
+simulation):
+
+* ``desync`` — each rank registers a differently-shaped sum state; the
+  pre-flight schema exchange must fail fast on BOTH ranks with a
+  :class:`SyncDesyncError` naming the diverged peer and state, instead of
+  hanging or miscompiling the gather.
+* ``stall`` — rank 1 never joins the sync and exits late; rank 0 must get a
+  :class:`SyncTimeoutError` within its ``sync_timeout`` budget instead of
+  blocking forever on the dead peer.
 """
 
 import os
 import sys
 
 
+def _sync_exit(name: str) -> None:
+    """Exit both ranks together: the first ``os._exit`` would kill the
+    rank-0 coordination service and the survivor's error-polling thread
+    aborts the whole process (SIGABRT) — so rendezvous first, then exit."""
+    from jax._src import distributed
+
+    distributed.global_state.client.wait_at_barrier(name, 60_000)
+    os._exit(0)
+
+
+def _scenario_desync(rank: int, nproc: int) -> None:
+    import jax.numpy as jnp
+
+    from metrics_tpu.metric import Metric
+    from metrics_tpu.utils.exceptions import SyncDesyncError
+
+    class ShapedSum(Metric):
+        full_state_update = True
+
+        def __init__(self, n: int, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("vec", jnp.zeros(n, jnp.float32), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.vec = self.vec + jnp.asarray(x, dtype=jnp.float32)
+
+        def compute(self):
+            return self.vec.sum()
+
+    # a straggler restarted with different code: state shape (rank+1,)
+    m = ShapedSum(rank + 1)
+    m.update(jnp.ones(rank + 1))
+    try:
+        m.compute()
+    except SyncDesyncError as err:
+        assert err.rank == 1 - rank, (err.rank, rank)
+        assert err.state == "vec", err.state
+        assert "vec" in str(err) and f"rank {1 - rank}" in str(err)
+        print(f"DCN_DESYNC_OK rank={rank} peer={err.rank} state={err.state}", flush=True)
+        sys.stdout.flush()
+        _sync_exit("desync_exit")
+    raise AssertionError("desync went undetected: the gather would have hung")
+
+
+def _scenario_stall(rank: int, nproc: int) -> None:
+    import time
+
+    from metrics_tpu.utils.exceptions import SyncTimeoutError
+    from tests.bases.dummies import DummyMetricSum
+
+    if rank != 0:
+        # dead peer: never participate in the sync; stay alive (so the
+        # coordination service keeps serving rank 0) until rank 0 is done
+        print(f"DCN_STALL_OK rank={rank} role=stalled", flush=True)
+        sys.stdout.flush()
+        _sync_exit("stall_exit")
+
+    m = DummyMetricSum(sync_timeout=4.0, sync_max_retries=0)
+    m.update(3.0)
+    start = time.monotonic()
+    try:
+        m.compute()
+    except SyncTimeoutError as err:
+        elapsed = time.monotonic() - start
+        assert elapsed < 10.0, f"watchdog too slow: {elapsed:.1f}s"
+        assert err.timeout == 4.0 and err.attempts == 1, (err.timeout, err.attempts)
+        assert m.last_sync_report["error"].startswith("SyncTimeoutError")
+        print(f"DCN_STALL_OK rank={rank} elapsed={elapsed:.1f}", flush=True)
+        sys.stdout.flush()
+        # the abandoned gather thread is still parked on the dead peer's
+        # key, so skip interpreter teardown: rendezvous and hard-exit
+        _sync_exit("stall_exit")
+    raise AssertionError("sync with a dead peer returned instead of timing out")
+
+
 def main() -> None:
     rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -22,6 +109,12 @@ def main() -> None:
     jax.distributed.initialize(
         coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=rank
     )
+    if scenario == "desync":
+        _scenario_desync(rank, nproc)
+        return
+    if scenario == "stall":
+        _scenario_stall(rank, nproc)
+        return
     import numpy as np
     import jax.numpy as jnp
 

@@ -85,19 +85,13 @@ def test_axis_context_routing():
     assert current_axis() is None
 
 
-def test_multihost_two_process_real():
-    """Real spawned 2-process DCN sync through Metric.compute().
-
-    TPU translation of the reference's gloo process-group tests
-    (``tests/unittests/bases/test_ddp.py:63-81``): two ``jax.distributed``
-    CPU processes, uneven cat-state gather + sum-state reduction, symmetric
-    results, unsync-restores-local-state — all exercised in
-    ``tests/bases/_dcn_worker.py``.
-    """
+def _spawn_dcn_workers(scenario=None, timeout=300):
+    """Spawn the 2-process DCN worker, return ``[(returncode, output), ...]``."""
     import os
     import socket
     import subprocess
     import sys
+    from concurrent.futures import ThreadPoolExecutor
 
     sock = socket.socket()
     sock.bind(("localhost", 0))
@@ -110,11 +104,10 @@ def test_multihost_two_process_real():
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     nproc = 2
-    from concurrent.futures import ThreadPoolExecutor
-
+    argv_tail = [str(port)] + ([scenario] if scenario else [])
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(r), str(nproc), str(port)],
+            [sys.executable, worker, str(r), str(nproc)] + argv_tail,
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -126,15 +119,50 @@ def test_multihost_two_process_real():
         # drain both pipes concurrently: a worker blocking on a full stdout
         # pipe mid-collective would deadlock the other rank too
         with ThreadPoolExecutor(nproc) as pool:
-            outs = [f.result() for f in [pool.submit(p.communicate, timeout=300) for p in procs]]
+            outs = [
+                f.result() for f in [pool.submit(p.communicate, timeout=timeout) for p in procs]
+            ]
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for r, (p, (out, _)) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+    return [(p.returncode, out) for p, (out, _) in zip(procs, outs)]
+
+
+def test_multihost_two_process_real():
+    """Real spawned 2-process DCN sync through Metric.compute().
+
+    TPU translation of the reference's gloo process-group tests
+    (``tests/unittests/bases/test_ddp.py:63-81``): two ``jax.distributed``
+    CPU processes, uneven cat-state gather + sum-state reduction, symmetric
+    results, unsync-restores-local-state — all exercised in
+    ``tests/bases/_dcn_worker.py``.
+    """
+    for r, (code, out) in enumerate(_spawn_dcn_workers()):
+        assert code == 0, f"rank {r} failed:\n{out}"
         assert f"DCN_WORKER_OK rank={r}" in out
+
+
+def test_multihost_desynced_peer_fails_fast():
+    """A peer that registered a differently-shaped state must be caught by
+    the pre-flight schema exchange on BOTH ranks — a diagnostic
+    ``SyncDesyncError`` naming the diverged rank and state — instead of the
+    gather hanging every healthy rank."""
+    for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="desync", timeout=120)):
+        assert code == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_DESYNC_OK rank={r} peer={1 - r} state=vec" in out
+
+
+def test_multihost_stalled_peer_times_out():
+    """A peer that never joins the sync must trip rank 0's watchdog within
+    its ``sync_timeout`` budget — a ``SyncTimeoutError`` with retry/timeout
+    diagnostics — instead of blocking the evaluation forever."""
+    results = _spawn_dcn_workers(scenario="stall", timeout=120)
+    for r, (code, out) in enumerate(results):
+        assert code == 0, f"rank {r} failed:\n{out}"
+    assert "DCN_STALL_OK rank=0" in results[0][1]
+    assert "DCN_STALL_OK rank=1 role=stalled" in results[1][1]
 
 
 def test_multihost_uneven_gather_unit():

@@ -1,0 +1,406 @@
+"""Deterministic CPU-only fault-injection suite for the sync fault layer.
+
+Exercises all four failure modes (timeout, desync, corruption, peer drop)
+and the three ``on_sync_error`` policies through :class:`ChaosBackend`
+schedules — on NullBackend-backed simulated worlds and the 8-device mesh.
+The real 2-process DCN scenarios live in ``test_ddp.py``.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.parallel import (
+    ChaosBackend,
+    ChaosInjectedError,
+    NullBackend,
+    SyncOptions,
+    find_schema_divergence,
+    guarded_collective,
+    schema_digest_rows,
+)
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.utils.exceptions import (
+    SyncDesyncError,
+    SyncError,
+    SyncIntegrityError,
+    SyncTimeoutError,
+)
+
+from tests.bases.dummies import DummyMetricSum
+
+
+def _chaos(schedule, timeout=None, retries=0, backoff=0.01, world=2):
+    return ChaosBackend(
+        NullBackend(),
+        schedule=schedule,
+        world_size=world,
+        options=SyncOptions(timeout=timeout, max_retries=retries, backoff=backoff),
+    )
+
+
+# --------------------------------------------------------------- guard layer
+class TestGuardedCollective:
+    def test_timeout_raises_with_diagnostics(self):
+        import time
+
+        with pytest.raises(SyncTimeoutError) as err:
+            guarded_collective(
+                lambda: time.sleep(5), SyncOptions(timeout=0.1), label="total"
+            )
+        assert err.value.state == "total"
+        assert err.value.timeout == 0.1
+        assert err.value.attempts == 1
+
+    def test_retry_then_succeed_counts_retries(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("transient")
+            return 7
+
+        tel = {}
+        opts = SyncOptions(timeout=1.0, max_retries=2, backoff=0.001)
+        assert guarded_collective(flaky, opts, telemetry=tel) == 7
+        assert tel["retries"] == 2
+
+    def test_transient_error_rethrown_after_budget(self):
+        def always_bad():
+            raise RuntimeError("broken link")
+
+        with pytest.raises(RuntimeError, match="broken link"):
+            guarded_collective(always_bad, SyncOptions(timeout=1.0, max_retries=1, backoff=0.001))
+
+    def test_sync_error_propagates_without_retry(self):
+        calls = {"n": 0}
+
+        def desynced():
+            calls["n"] += 1
+            raise SyncDesyncError("peer diverged", rank=3)
+
+        with pytest.raises(SyncDesyncError):
+            guarded_collective(desynced, SyncOptions(timeout=1.0, max_retries=5, backoff=0.001))
+        assert calls["n"] == 1  # a verdict, not a transient: no retry burn
+
+    def test_no_timeout_runs_inline(self):
+        assert guarded_collective(lambda: 11, SyncOptions(timeout=None)) == 11
+
+
+class TestSyncOptions:
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_TIMEOUT", "12.5")
+        monkeypatch.setenv("METRICS_TPU_SYNC_MAX_RETRIES", "3")
+        monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF", "0.25")
+        opts = SyncOptions.from_env()
+        assert opts == SyncOptions(timeout=12.5, max_retries=3, backoff=0.25)
+
+    def test_explicit_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_TIMEOUT", "12.5")
+        opts = SyncOptions.resolve(timeout=1.0, max_retries=None, backoff=None)
+        assert opts.timeout == 1.0
+        assert opts.max_retries == 0
+
+    def test_metric_kwargs_reach_options(self):
+        m = DummyMetricSum(sync_timeout=2.0, sync_max_retries=4, sync_backoff=0.1)
+        assert m._sync_options() == SyncOptions(timeout=2.0, max_retries=4, backoff=0.1)
+
+
+# ------------------------------------------------------------ schema digests
+class TestSchemaDigests:
+    def test_rows_shape_and_determinism(self):
+        entries = [("tp", "sum:(4,):int64"), ("fp", "sum:(4,):int64")]
+        rows = schema_digest_rows(entries)
+        assert rows.shape == (2, 16)
+        np.testing.assert_array_equal(rows, schema_digest_rows(entries))
+
+    def test_divergence_found_and_named(self):
+        a = schema_digest_rows([("tp", "sum:(4,):int64"), ("fp", "sum:(4,):int64")])
+        b = schema_digest_rows([("tp", "sum:(4,):int64"), ("fp", "sum:(8,):int64")])
+        gathered = np.stack([a, a, b])
+        assert find_schema_divergence(gathered, 0) == (2, 1)
+        assert find_schema_divergence(np.stack([a, a]), 0) is None
+
+    def test_uneven_cat_leading_dims_do_not_diverge(self):
+        # uneven data shards are legal: cat/list signatures ignore leading dims
+        m1, m2 = MeanSquaredError(), MeanSquaredError()
+        m1.update(jnp.ones(3), jnp.zeros(3))
+        m2.update(jnp.ones(8), jnp.zeros(8))
+        assert m1._schema_entries() == m2._schema_entries()
+
+
+# --------------------------------------------------- failure mode x policy
+class TestFailureModes:
+    def test_timeout_raise_policy(self):
+        # peer drop: the collective parks forever, the watchdog fires
+        m = DummyMetricSum(
+            on_sync_error="raise",
+            sync_backend=_chaos({0: ("drop", 30.0)}, timeout=0.2),
+        )
+        m.update(2.0)
+        with pytest.raises(SyncTimeoutError) as err:
+            m.compute()
+        assert err.value.timeout == 0.2
+        assert m.last_sync_report["error"].startswith("SyncTimeoutError")
+        assert m.last_sync_report["fallback"] is None
+
+    def test_timeout_names_in_flight_state_and_progress(self):
+        # op 0 = preflight, op 1 = the 'x' state gather
+        m = DummyMetricSum(
+            on_sync_error="raise",
+            sync_backend=_chaos({1: ("drop", 30.0)}, timeout=0.2),
+        )
+        m.update(2.0)
+        with pytest.raises(SyncTimeoutError) as err:
+            m.compute()
+        assert err.value.state == "x"
+        assert err.value.synced_states == []
+
+    def test_retry_then_succeed_recovers_value(self):
+        m = DummyMetricSum(
+            sync_backend=_chaos({0: ("delay", 1.0)}, timeout=0.1, retries=1)
+        )
+        m.update(5.0)
+        assert float(m.compute()) == 5.0
+        assert m.last_sync_report["retries"] == 1
+        assert m.last_sync_report["error"] is None
+
+    def test_desync_detected_with_rank_and_state(self):
+        m = DummyMetricSum(sync_backend=_chaos({0: "desync"}, world=4))
+        m.update(1.0)
+        with pytest.raises(SyncDesyncError) as err:
+            m.compute()
+        assert err.value.rank == 3
+        assert err.value.state == "x"
+        assert "'x'" in str(err.value) and "rank 3" in str(err.value)
+
+    def test_corruption_caught_by_validate_sync(self):
+        # op 0 = preflight, op 1 = first float state gather
+        m = MeanSquaredError(
+            validate_sync=True,
+            sync_backend=_chaos({1: "corrupt"}),
+        )
+        m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.0]))
+        with pytest.raises(SyncIntegrityError) as err:
+            m.compute()
+        assert err.value.state == "sum_squared_error"
+        assert err.value.phase == "post-sync"
+        assert err.value.problem == "non-finite values"
+
+    def test_corruption_unnoticed_without_validate_sync(self):
+        m = MeanSquaredError(sync_backend=_chaos({1: "corrupt"}))
+        m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.0]))
+        assert bool(jnp.isnan(m.compute()))  # silent poison: the check is opt-in
+
+    def test_injected_error_exhausts_budget_and_rethrows(self):
+        m = DummyMetricSum(
+            sync_backend=_chaos({0: "error", 1: "error"}, timeout=1.0)
+        )
+        m.update(1.0)
+        with pytest.raises(ChaosInjectedError):
+            m.compute()
+
+
+class TestDegradationPolicies:
+    def test_local_fallback_keeps_compute_alive(self):
+        m = DummyMetricSum(
+            on_sync_error="local",
+            sync_backend=_chaos({0: ("drop", 30.0)}, timeout=0.2),
+        )
+        m.update(3.0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            value = m.compute()
+        assert float(value) == 3.0  # local unsynced value, not a hang
+        assert any("falling back to local" in str(w.message) for w in caught)
+        assert m.last_sync_report["fallback"] == "local"
+        # the fallback must leave the metric usable: unsync + further updates
+        assert not m._is_synced
+        m.update(2.0)
+        m._computed = None
+        assert float(m.compute()) == 5.0
+
+    def test_skip_policy_is_silent(self):
+        m = DummyMetricSum(
+            on_sync_error="skip",
+            sync_backend=_chaos({0: ("drop", 30.0)}, timeout=0.2),
+        )
+        m.update(3.0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            value = m.compute()
+        assert float(value) == 3.0
+        assert not any("falling back" in str(w.message) for w in caught)
+        assert m.last_sync_report["fallback"] == "local"
+
+    def test_local_fallback_on_desync(self):
+        m = DummyMetricSum(on_sync_error="local", sync_backend=_chaos({0: "desync"}))
+        m.update(4.0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert float(m.compute()) == 4.0
+        assert m.last_sync_report["error"].startswith("SyncDesyncError")
+
+    def test_programming_errors_never_degraded(self):
+        # non-SyncError failures must propagate even under policy "local"
+        class ExplodingBackend(ChaosBackend):
+            def preflight_check(self, entries, update_count=0):
+                raise TypeError("bad argument")
+
+        m = DummyMetricSum(
+            on_sync_error="local",
+            sync_backend=ExplodingBackend(NullBackend(), world_size=2),
+        )
+        m.update(1.0)
+        with pytest.raises(TypeError, match="bad argument"):
+            m.compute()
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="on_sync_error"):
+            DummyMetricSum(on_sync_error="explode")
+
+
+# ------------------------------------------------------------- chaos backend
+class TestChaosBackend:
+    def test_unknown_fault_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            ChaosBackend(NullBackend(), schedule={0: "meteor"})
+
+    def test_one_shot_consumption(self):
+        cb = _chaos({0: "error"}, timeout=1.0)
+        with pytest.raises(ChaosInjectedError):
+            cb.psum(jnp.ones(2))
+        # the fault was consumed: the next collective runs clean
+        np.testing.assert_allclose(np.asarray(cb.psum(jnp.ones(2))), np.ones(2))
+        assert cb.injected == [(0, "error")]
+
+    def test_seeded_probabilistic_schedule_is_deterministic(self):
+        def run():
+            cb = ChaosBackend(
+                NullBackend(),
+                seed=42,
+                fault_probs={"delay": 0.5},
+                world_size=2,
+                delay_secs=0.0,
+                options=SyncOptions(timeout=None),
+            )
+            for _ in range(20):
+                cb.psum(jnp.ones(1))
+            return list(cb.injected)
+
+        first, second = run(), run()
+        assert first == second
+        assert first  # seed 42 injects at least once in 20 draws
+
+    def test_simulated_world_size(self):
+        cb = ChaosBackend(NullBackend(), world_size=8)
+        assert cb.is_distributed()
+        assert cb.world_size() == 8
+        assert ChaosBackend(NullBackend()).is_distributed() is False
+
+    def test_telemetry_merges_fault_log(self):
+        cb = _chaos({0: ("delay", 1.0)}, timeout=0.1, retries=1)
+        cb.pmean(jnp.ones(1))
+        tel = cb.pop_telemetry()
+        assert tel["retries"] == 1
+        assert tel["faults_injected"] == 1
+        assert cb.pop_telemetry()["faults_injected"] == 1  # log persists; counters reset
+
+
+# ---------------------------------------------------------------- telemetry
+class TestLastSyncReport:
+    def test_success_report_fields(self):
+        m = DummyMetricSum(sync_backend=ChaosBackend(NullBackend(), world_size=2))
+        m.update(1.0)
+        m.compute()
+        report = m.last_sync_report
+        assert report["backend"] == "ChaosBackend"
+        assert report["world_size"] == 2
+        assert report["error"] is None and report["fallback"] is None
+        assert report["duration_secs"] >= 0
+        assert {"retries", "gather_calls", "bytes_gathered"} <= set(report)
+
+    def test_no_report_without_distributed_sync(self):
+        m = DummyMetricSum()
+        m.update(1.0)
+        m.compute()
+        assert m.last_sync_report is None
+
+    def test_collection_policy_propagation_and_aggregate_report(self):
+        mc = MetricCollection(
+            {
+                "acc": Accuracy(num_classes=3, validate_args=False),
+                "mse": MeanSquaredError(),
+            },
+            on_sync_error="local",
+            sync_timeout=7.5,
+            validate_sync=True,
+        )
+        for m in mc.values():
+            assert m.on_sync_error == "local"
+            assert m.sync_timeout == 7.5
+            assert m.validate_sync is True
+        assert set(mc.last_sync_report) == {"acc", "mse"}
+        with pytest.raises(ValueError, match="on_sync_error"):
+            MetricCollection({"mse": MeanSquaredError()}, on_sync_error="explode")
+
+    def test_collection_members_degrade_independently(self):
+        acc = Accuracy(num_classes=3, validate_args=False)
+        mse = MeanSquaredError(
+            on_sync_error="local",
+            sync_backend=_chaos({0: ("drop", 30.0)}, timeout=0.2),
+        )
+        mc = MetricCollection({"acc": acc, "mse": mse}, compute_groups=False)
+        mc.update(jnp.asarray([0.0, 1.0, 2.0]), jnp.asarray([0.0, 1.0, 1.0]))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = mc.compute()
+        assert set(out) == {"acc", "mse"}
+        report = mc.last_sync_report
+        assert report["acc"] is None  # NullBackend: no distributed sync attempted
+        assert report["mse"]["fallback"] == "local"
+
+
+# ----------------------------------------------------------------- mesh tier
+def test_mesh_sync_unaffected_by_fault_kwargs():
+    """Fault-tolerance kwargs must not perturb the in-trace (AxisBackend)
+    tier: its collectives compile into one SPMD program where the eager
+    watchdog/preflight machinery stands down."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+
+    m = DummyMetricSum(sync_timeout=0.001, sync_max_retries=2, validate_sync=True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("ddp",))
+
+    def run(x):
+        state = m.init_state()
+        state = m.apply_update(state, x.squeeze())
+        return jnp.asarray(m.apply_compute(state, axis_name="ddp"))[None]
+
+    xs = jnp.arange(4, dtype=jnp.float32)
+    out = shard_map(run, mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp"))(xs)
+    np.testing.assert_allclose(np.asarray(out), np.full(4, 6.0))
+
+
+def test_forward_dist_sync_on_step_with_chaos_local_policy():
+    """dist_sync_on_step forward keeps streaming through a faulted sync."""
+    m = DummyMetricSum(
+        dist_sync_on_step=True,
+        on_sync_error="local",
+        sync_backend=_chaos({0: ("drop", 30.0)}, timeout=0.2),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m(1.0)
+        m(2.0)
+    m.sync_on_compute = False
+    assert float(m.compute()) == 3.0

@@ -20,6 +20,20 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 assert len(jax.devices()) >= 8, "test rig needs the 8-device virtual CPU platform"
 
+if not hasattr(jax, "shard_map"):  # promoted out of experimental in jax 0.5
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def _shard_map_compat(f, *args, **kwargs):
+        # the experimental API spells jax 0.5's check_vma as check_rep
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, *args, **kwargs)
+
+    jax.shard_map = _shard_map_compat
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
