@@ -857,6 +857,21 @@ def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     print(f"MAP_DDP_OK {elapsed:.6f} {first:.6f} {last:.6f}", flush=True)
 
 
+def _obs_counters():
+    """Raw obs counter snapshot (counters tick even with spans disabled)."""
+    from metrics_tpu.obs import counters_snapshot
+
+    return counters_snapshot()
+
+
+def _obs_delta(before, after):
+    """Compact attribution dict for the counters that moved between snapshots."""
+    from metrics_tpu.obs import summarize_counters
+
+    delta = {k: v - before.get(k, 0) for k, v in after.items() if v != before.get(k, 0)}
+    return summarize_counters(delta)
+
+
 def main() -> None:
     import jax
 
@@ -872,9 +887,11 @@ def main() -> None:
     except Exception:
         pass
 
+    obs_before = _obs_counters()
     fused, device_rate, rate_note, _value, med_times = _bench_accuracy_fused()
     looped = _bench_accuracy_looped(lazy=True)
     looped_eager = _bench_accuracy_looped(lazy=False)
+    config1_obs = _obs_delta(obs_before, _obs_counters())
     ref = _bench_torch_reference()
     vs_baseline = (fused / ref) if ref else 1.0
     extra = {
@@ -890,6 +907,8 @@ def main() -> None:
         "config1_median_stream_secs": {str(k): round(v, 6) for k, v in med_times.items()},
         "config1_torch_cpu_samples_per_sec": round(ref, 1) if ref else None,
     }
+    if config1_obs:
+        extra["config1_obs"] = config1_obs
     try:
         # context for the looped numbers: host-resident batches are bounded
         # by this transfer rate (tiny through the axon tunnel), not by the
@@ -906,6 +925,7 @@ def main() -> None:
         ("config5_map_segm_scale_images_per_sec", _bench_map_segm_scale),
         ("device_mfu", _bench_mfu),
     ):
+        obs_before = _obs_counters()
         try:
             result = fn()
             if name.startswith("config3"):
@@ -930,6 +950,13 @@ def main() -> None:
                 extra[name] = round(result, 1)
         except Exception as err:  # never let a secondary config break the line
             extra[name] = f"error: {type(err).__name__}: {err}"
+        section = name.split("_")[0] if name.startswith("config") else name
+        obs_section = _obs_delta(obs_before, _obs_counters())
+        if obs_section:
+            extra[f"{section}_obs"] = obs_section
+    obs_totals = _obs_delta({}, _obs_counters())
+    if obs_totals:
+        extra["obs_totals"] = obs_totals
     record = {
         "metric": "accuracy_updates_per_sec",
         "value": round(fused, 1),
@@ -946,7 +973,7 @@ def main() -> None:
     compact["extra"] = {
         k: v
         for k, v in extra.items()
-        if k == "device_mfu"
+        if k in ("device_mfu", "obs_totals")
         or not isinstance(v, dict)
     }
     print(json.dumps(compact))

@@ -20,9 +20,12 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric, _flatten_batched_inputs
+from metrics_tpu.obs import core as _obs
 from metrics_tpu.utils.data import _flatten_dict, allclose
 
 Array = jax.Array
+
+_OBS_RT = _obs._rt
 
 
 class MetricCollection:
@@ -193,6 +196,12 @@ class MetricCollection:
     # ------------------------------------------------------------------ calls
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-metric forward; returns {name: batch value} (reference :151-159)."""
+        if _OBS_RT.enabled:
+            with _obs.span("collection.forward", members=len(self._modules)):
+                return self._forward_unspanned(*args, **kwargs)
+        return self._forward_unspanned(*args, **kwargs)
+
+    def _forward_unspanned(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         res = {
             k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()
         }
@@ -219,6 +228,12 @@ class MetricCollection:
 
     def _update_via(self, method_name: str, *args: Any, **kwargs: Any) -> None:
         """Shared grouped/ungrouped dispatch for update and update_batched."""
+        if _OBS_RT.enabled:
+            with _obs.span("collection." + method_name, members=len(self._modules)):
+                return self._update_via_unspanned(method_name, *args, **kwargs)
+        return self._update_via_unspanned(method_name, *args, **kwargs)
+
+    def _update_via_unspanned(self, method_name: str, *args: Any, **kwargs: Any) -> None:
         if self._groups_checked:
             fused = False
             if self._fused_enabled:
@@ -257,6 +272,7 @@ class MetricCollection:
             m._update_count += 1
         if self._fused_update is None:
             def fused(states: List[Dict[str, Any]], a: tuple, kw: dict) -> List[Dict[str, Any]]:
+                _obs.count_trace("MetricCollection", "fused_update")
                 out = []
                 for m, st in zip(leaders, states):
                     _, new = m._run_with_state(st, m._update_impl, a, m._filter_kwargs(**kw))
@@ -281,6 +297,7 @@ class MetricCollection:
             # not cost the fused path for the collection's lifetime
             self._fused_enabled = False
             self._fused_update = None
+            _obs.counter_inc("eager_fallback", site="collection.fused_update")
             for m in leaders:
                 m._update_count -= 1
             return False
@@ -326,6 +343,8 @@ class MetricCollection:
         fused = self._fused_update_batched.get(statics_key)
         if fused is None:
             def fused_many(states: List[Dict[str, Any]], arr_stack: tuple) -> List[Dict[str, Any]]:
+                _obs.count_trace("MetricCollection", "fused_update_batched")
+
                 def body(sts: List[Dict[str, Any]], sl: tuple):
                     it = iter(sl)
                     leaves = [next(it) if b else s for b, s in zip(is_batched, statics)]
@@ -355,6 +374,7 @@ class MetricCollection:
             # trace-time failure: nothing executed; demote until reset()
             self._fused_enabled = False
             self._fused_update_batched.pop(statics_key, None)
+            _obs.counter_inc("eager_fallback", site="collection.fused_update_batched")
             for m in leaders:
                 m._update_count -= n
             return False
@@ -456,6 +476,14 @@ class MetricCollection:
                 member._computed = None
 
     def compute(self) -> Dict[str, Any]:
+        if _OBS_RT.enabled:
+            # member metric.compute spans nest under this one, giving
+            # per-member time attribution for the collection call
+            with _obs.span("collection.compute", members=len(self._modules)):
+                return self._compute_unspanned()
+        return self._compute_unspanned()
+
+    def _compute_unspanned(self) -> Dict[str, Any]:
         res = {k: m.compute() for k, m in self._modules.items()}
         res = _flatten_dict(res)
         return {self._to_key(k): v for k, v in res.items()}
@@ -545,6 +573,45 @@ class MetricCollection:
         sync yet.
         """
         return {name: m.last_sync_report for name, m in self._modules.items()}
+
+    @property
+    def sync_report_history(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Per-member bounded report rings: ``{name: [oldest, ..., newest]}``."""
+        return {name: list(m.sync_report_history) for name, m in self._modules.items()}
+
+    def aggregate_sync_report(self) -> Dict[str, Any]:
+        """Roll every member's LATEST sync report into collection totals.
+
+        Sums the additive fields (duration, retries, attempts, gather calls,
+        bytes, backoff) and collects per-member errors, so a training loop can
+        log one line per collection sync instead of one per member.
+        """
+        totals: Dict[str, Any] = {
+            "members_reporting": 0,
+            "duration_secs": 0.0,
+            "retries": 0,
+            "attempts": 0,
+            "gather_calls": 0,
+            "bytes_gathered": 0,
+            "backoff_secs": 0.0,
+            "errors": [],
+        }
+        for name, m in self._modules.items():
+            rep = m.last_sync_report
+            if not rep:
+                continue
+            totals["members_reporting"] += 1
+            totals["duration_secs"] = round(
+                totals["duration_secs"] + float(rep.get("duration_secs") or 0.0), 6
+            )
+            totals["backoff_secs"] = round(
+                totals["backoff_secs"] + float(rep.get("backoff_secs") or 0.0), 6
+            )
+            for key in ("retries", "attempts", "gather_calls", "bytes_gathered"):
+                totals[key] += int(rep.get(key) or 0)
+            if rep.get("error"):
+                totals["errors"].append({"member": name, "error": rep["error"]})
+        return totals
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

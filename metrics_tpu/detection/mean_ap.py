@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs import core as _obs
 
 Array = jax.Array
 
@@ -519,6 +520,10 @@ class MeanAveragePrecision(Metric):
         miss = np.asarray([b for b in range(B) if keys[b] not in cache], np.int64)
         self._iou_blocks_new = int(miss.size)
         self._iou_blocks_hit = B - int(miss.size)
+        if self._iou_blocks_hit:
+            _obs.counter_inc("iou_cache.hits", self._iou_blocks_hit, metric=type(self).__name__)
+        if self._iou_blocks_new:
+            _obs.counter_inc("iou_cache.misses", self._iou_blocks_new, metric=type(self).__name__)
         for b in range(B):
             if keys[b] in cache:
                 cache.move_to_end(keys[b])

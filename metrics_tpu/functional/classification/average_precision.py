@@ -11,7 +11,6 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -49,9 +48,13 @@ def _average_precision_compute_with_precision_recall(
         if average == "macro" or (weights is not None and bool(jnp.isclose(jnp.sum(weights), 0.0))):
             has_nan = bool(jnp.any(jnp.isnan(res_t)))
             if has_nan:
-                rank_zero_warn(
+                from metrics_tpu.obs.logging import warn_once
+
+                # eager-path check that re-fires on every streaming compute
+                warn_once(
                     "Average precision score for one or more classes was `nan`. Ignoring these classes in macro-average",
                     UserWarning,
+                    key="average_precision.nan_classes",
                 )
             return jnp.nanmean(res_t) if has_nan else jnp.mean(res_t)
         weights = weights / jnp.sum(weights)

@@ -13,7 +13,6 @@ import jax.numpy as jnp
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.data import _bincount, _confusion_counts
 from metrics_tpu.utils.enums import DataType
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -61,7 +60,13 @@ def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -
             confmat = confmat / jnp.sum(confmat)
         nan_mask = jnp.isnan(confmat)
         if not isinstance(confmat, jax.core.Tracer) and bool(jnp.any(nan_mask)):
-            rank_zero_warn("nan values found in confusion matrix have been replaced with zeros.")
+            from metrics_tpu.obs.logging import warn_once
+
+            # eager-path check that re-fires on every streaming compute
+            warn_once(
+                "nan values found in confusion matrix have been replaced with zeros.",
+                key="confusion_matrix.nan_replaced",
+            )
         confmat = jnp.where(nan_mask, 0.0, confmat)
     return confmat
 

@@ -5,8 +5,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.obs.logging import warn_once
 from metrics_tpu.utils.checks import _check_same_shape
-from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -57,10 +57,13 @@ def _r2_score_compute(
     if adjusted != 0:
         # n_obs may be traced; the degenerate-count warnings only fire eagerly
         if not isinstance(n_obs, jax.core.Tracer) and adjusted >= int(n_obs) - 1:
-            rank_zero_warn(
+            # once per process: this fires on every compute of a streaming
+            # metric, so an eval loop would repeat it per step per rank
+            warn_once(
                 "More independent regressions than data points in adjusted r2 score. "
                 "Falls back to standard r2 score.",
                 UserWarning,
+                key="r2.adjusted_degenerate",
             )
         else:
             r2 = 1 - (1 - r2) * (n_obs - 1) / (n_obs - adjusted - 1)

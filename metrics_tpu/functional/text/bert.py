@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import core as _obs
+
 Array = jax.Array
 
 
@@ -155,16 +157,18 @@ def _jitted_model_call(model: Any, need_hidden: bool):
             takes_params = True  # HF-style; the except path below covers misfires
 
     if takes_params:
-        jitted = jax.jit(
-            lambda p, ids, mask, **kw: model_ref()(input_ids=ids, attention_mask=mask, params=p, **kw),
-            static_argnames=("output_hidden_states",),
-        )
+        def _traced(p, ids, mask, **kw):
+            _obs.count_trace("BERTScore", "encoder")
+            return model_ref()(input_ids=ids, attention_mask=mask, params=p, **kw)
+
+        jitted = jax.jit(_traced, static_argnames=("output_hidden_states",))
         run = lambda ids, mask, **kw: jitted(model_ref().params, ids, mask, **kw)  # noqa: E731
     else:
-        jitted = jax.jit(
-            lambda ids, mask, **kw: model_ref()(input_ids=ids, attention_mask=mask, **kw),
-            static_argnames=("output_hidden_states",),
-        )
+        def _traced(ids, mask, **kw):
+            _obs.count_trace("BERTScore", "encoder")
+            return model_ref()(input_ids=ids, attention_mask=mask, **kw)
+
+        jitted = jax.jit(_traced, static_argnames=("output_hidden_states",))
         run = jitted
 
     def eager(i, m, **k):
@@ -191,6 +195,7 @@ def _jitted_model_call(model: Any, need_hidden: bool):
             # Transient RUNTIME errors (device OOM, ...) propagate instead of
             # silently demoting the model to per-op eager dispatch.
             impl["fn"] = eager
+            _obs.counter_inc("eager_fallback", site="text.bert.encoder")
             return eager(ids, mask, **kw)
 
     per_model[need_hidden] = call

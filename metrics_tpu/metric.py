@@ -30,12 +30,14 @@ import os
 import time
 import warnings
 from abc import ABC, abstractmethod
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import core as _obs
 from metrics_tpu.parallel.backend import Backend, SyncOptions, get_backend, reduce_synced_state
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utils.exceptions import (
@@ -47,6 +49,11 @@ from metrics_tpu.utils.exceptions import (
 from metrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
+
+# single-load alias: the hot wrappers below pay one attribute read + branch
+# when observability is disabled (the singleton is never replaced, only its
+# ``enabled`` flag flips)
+_OBS_RT = _obs._rt
 
 _ALLOWED_REDUCE = ("sum", "mean", "max", "min", "cat")
 
@@ -215,6 +222,9 @@ class Metric(ABC):
         )
         self.sync_backend = kwargs.pop("sync_backend", None)
         self.last_sync_report: Optional[Dict[str, Any]] = None
+        # bounded per-metric ring of recent sync reports (newest last); the
+        # process-wide view lives in the obs registry (obs.sync_reports())
+        self.sync_report_history: deque = deque(maxlen=16)
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
         # lazy-update accumulator: eager `update` calls append here and flush
@@ -882,6 +892,7 @@ class Metric(ABC):
         prog = self._jitted_flush.get(key)
         if prog is None:
             def flush_prog(state: Dict[str, Any], np_stacks: tuple, dev_cols: tuple) -> Dict[str, Any]:
+                _obs.count_trace(type(self).__name__, "flush")
                 np_it, dev_it = iter(np_stacks), iter(dev_cols)
                 arr_stack = tuple(
                     next(np_it) if kind == "np" else jnp.stack(next(dev_it))
@@ -923,6 +934,12 @@ class Metric(ABC):
         return True
 
     def _update_wrapper(self, *args: Any, **kwargs: Any) -> None:
+        if _OBS_RT.enabled:
+            with _obs.span("metric.update", metric=type(self).__name__):
+                return self._update_unspanned(*args, **kwargs)
+        return self._update_unspanned(*args, **kwargs)
+
+    def _update_unspanned(self, *args: Any, **kwargs: Any) -> None:
         if self._is_synced:
             raise MetricsTPUUserError(
                 "The Metric has already been synced; re-syncing or updating while synced is forbidden."
@@ -963,6 +980,7 @@ class Metric(ABC):
         if use_jit:
             if self._jitted_update is None:
                 def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
+                    _obs.count_trace(type(self).__name__, "update")
                     _, new_state = self._run_with_state(state, self._update_impl, args, kwargs)
                     return new_state
 
@@ -980,6 +998,7 @@ class Metric(ABC):
                 # update body needs concrete values; permanently fall back
                 self.jit_update = False
                 self._jitted_update = None
+                _obs.counter_inc("eager_fallback", site="metric.update", metric=type(self).__name__)
                 self._update_impl(*args, **kwargs)
             else:
                 self._state.update(new_state)
@@ -1010,6 +1029,12 @@ class Metric(ABC):
         unchanged to every slice.  Falls back to the per-slice Python loop for
         list states and non-jittable inputs.
         """
+        if _OBS_RT.enabled:
+            with _obs.span("metric.update_batched", metric=type(self).__name__):
+                return self._update_batched_unspanned(*args, **kwargs)
+        return self._update_batched_unspanned(*args, **kwargs)
+
+    def _update_batched_unspanned(self, *args: Any, **kwargs: Any) -> None:
         self._flush_pending()  # earlier lazy updates come first in the stream
         all_leaves, treedef, is_batched, statics, n, ragged = _flatten_batched_inputs(args, kwargs)
         if n is None:
@@ -1109,6 +1134,7 @@ class Metric(ABC):
             def pure_update_many(
                 state: Dict[str, Any], arr_stack: tuple, default_state: Dict[str, Any]
             ) -> Dict[str, Any]:
+                _obs.count_trace(type(self).__name__, "update_batched")
                 # trace-time static stream length, read off the stack
                 n_eff = jax.tree_util.tree_leaves(arr_stack)[0].shape[0]
 
@@ -1142,6 +1168,8 @@ class Metric(ABC):
 
         def _build_scan_variant() -> Callable:
             def pure_update_many(state: Dict[str, Any], arr_stack: tuple) -> Dict[str, Any]:
+                _obs.count_trace(type(self).__name__, "update_batched")
+
                 def body(st: Dict[str, Any], sl: tuple) -> tuple:
                     sl_args, sl_kwargs = _rebuild(sl)
                     _, new = self._run_with_state(st, self._update_impl, sl_args, sl_kwargs)
@@ -1204,6 +1232,9 @@ class Metric(ABC):
             if new_state is None:
                 self._jitted_update_batched.pop(statics_key, None)
         if new_state is None:
+            _obs.counter_inc(
+                "eager_fallback", site="metric.update_batched", metric=type(self).__name__
+            )
             _loop_fallback(start=skip)
             return
         self._state.update(new_state)
@@ -1241,6 +1272,12 @@ class Metric(ABC):
         re-runs update on the cached global state
         (reference ``metric.py:241-280``).
         """
+        if _OBS_RT.enabled:
+            with _obs.span("metric.forward", metric=type(self).__name__):
+                return self._forward_unspanned(*args, **kwargs)
+        return self._forward_unspanned(*args, **kwargs)
+
+    def _forward_unspanned(self, *args: Any, **kwargs: Any) -> Any:
         if self._is_synced:
             raise MetricsTPUUserError("Calling forward while the metric is synced is forbidden.")
         self._flush_pending()  # the merge base must hold every prior update
@@ -1282,6 +1319,7 @@ class Metric(ABC):
         self._pre_update(*args, **kwargs)
         if self._jitted_forward is None:
             def fused(global_state: Dict[str, Any], global_count, a: tuple, kw: dict):
+                _obs.count_trace(type(self).__name__, "forward_fused")
                 batch_state = self.init_state()
                 _, batch_state = self._run_with_state(batch_state, self._update_impl, a, kw)
                 value, _ = self._run_with_state(batch_state, self._compute_impl, (), {})
@@ -1310,6 +1348,9 @@ class Metric(ABC):
             # the stepwise path (which handles its own eager fallbacks)
             self._forward_fused_ok = False
             self._jitted_forward = None
+            _obs.counter_inc(
+                "eager_fallback", site="metric.forward_fused", metric=type(self).__name__
+            )
             return _FUSED_FORWARD_FAILED
         self._forward_fused_ok = True
         self._state.update(merged)
@@ -1502,6 +1543,8 @@ class Metric(ABC):
         report["bytes_gathered"] = int(tel.pop("bytes_gathered", 0))
         report.update(tel)
         self.last_sync_report = report
+        self.sync_report_history.append(report)
+        _obs.record_sync_report(type(self).__name__, report)
 
     def sync(
         self,
@@ -1517,8 +1560,21 @@ class Metric(ABC):
         :class:`SyncDesyncError`, every collective runs under the watchdog +
         retry policy of :meth:`_sync_options`, and failures are handled per
         ``on_sync_error`` (``"local"``/``"skip"`` keep the cached local state
-        so compute stays live).  Each attempt records ``last_sync_report``.
+        so compute stays live).  Each attempt records ``last_sync_report`` and
+        appends to the bounded ``sync_report_history`` ring.
         """
+        if _OBS_RT.enabled:
+            with _obs.span("metric.sync", metric=type(self).__name__):
+                return self._sync_unspanned(dist_sync_fn, should_sync, distributed_available, backend)
+        return self._sync_unspanned(dist_sync_fn, should_sync, distributed_available, backend)
+
+    def _sync_unspanned(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[bool] = None,
+        backend: Optional[Backend] = None,
+    ) -> None:
         if self._is_synced:
             raise MetricsTPUUserError("The Metric has already been synced.")
         self._flush_pending()
@@ -1611,6 +1667,12 @@ class Metric(ABC):
 
     # ---------------------------------------------------------------- compute
     def _compute_wrapper(self) -> Any:
+        if _OBS_RT.enabled:
+            with _obs.span("metric.compute", metric=type(self).__name__):
+                return self._compute_unspanned()
+        return self._compute_unspanned()
+
+    def _compute_unspanned(self) -> Any:
         self._flush_pending()
         self._flush_host_buffers()
         if self._update_count == 0 and not self._update_called_warned:
@@ -1638,6 +1700,7 @@ class Metric(ABC):
         if can_jit:
             if self._jitted_compute is None:
                 def pure_compute(state: Dict[str, Any]) -> Any:
+                    _obs.count_trace(type(self).__name__, "compute")
                     out, _ = self._run_with_state(state, self._compute_impl, (), {})
                     return out
 
@@ -1653,6 +1716,9 @@ class Metric(ABC):
                 # compute body needs concrete values; permanently fall back
                 self.jit_compute = False
                 self._jitted_compute = None
+                _obs.counter_inc(
+                    "eager_fallback", site="metric.compute", metric=type(self).__name__
+                )
         return self._compute_impl()
 
     # ------------------------------------------------------------------ reset
@@ -1825,6 +1891,7 @@ class Metric(ABC):
         d["_defaults"] = {
             k: (v if isinstance(v, (list, int)) else jnp.asarray(v)) for k, v in d["_defaults"].items()
         }
+        d.setdefault("sync_report_history", deque(maxlen=16))
         self.__dict__.update(d)
         self._install_wrappers()
 

@@ -1,0 +1,63 @@
+"""Runtime observability for metrics_tpu: spans, counters, exporters.
+
+Quick start::
+
+    import metrics_tpu.obs as obs
+
+    obs.enable()                  # or METRICS_TPU_OBS=1 in the environment
+    ... run your eval loop ...
+    print(obs.report())           # spans, counters, recent sync reports
+    obs.dump_json("obs.json")
+    print(obs.prometheus_text())  # scrape-ready exposition format
+
+Counters (recompiles, sync bytes/attempts, cache hits, fault injections)
+are always on — they only tick on cold paths. Spans are sampled only while
+enabled; disabled, ``obs.span`` returns a shared no-op and the hot update
+path pays a single flag check. See ``docs/observability.md``.
+"""
+
+from metrics_tpu.obs.core import (
+    NOOP_SPAN,
+    count_trace,
+    counter_inc,
+    counter_value,
+    counters_snapshot,
+    disable,
+    enable,
+    enabled,
+    record_sync_report,
+    reset,
+    span,
+    spans_snapshot,
+    sync_reports,
+)
+from metrics_tpu.obs.exporters import (
+    dump_json,
+    parse_prometheus_text,
+    prometheus_text,
+    report,
+    summarize_counters,
+)
+from metrics_tpu.obs.logging import warn_once
+
+__all__ = [
+    "NOOP_SPAN",
+    "count_trace",
+    "counter_inc",
+    "counter_value",
+    "counters_snapshot",
+    "disable",
+    "dump_json",
+    "enable",
+    "enabled",
+    "parse_prometheus_text",
+    "prometheus_text",
+    "record_sync_report",
+    "report",
+    "reset",
+    "span",
+    "spans_snapshot",
+    "summarize_counters",
+    "sync_reports",
+    "warn_once",
+]

@@ -1,0 +1,232 @@
+"""Exporters: structured report, JSON dump, and Prometheus text format.
+
+The Prometheus renderer follows the text exposition format (one
+``name{labels} value`` line per series, ``# TYPE`` headers, counter series
+suffixed ``_total``). ``parse_prometheus_text`` is the matching line parser
+used by tests to round-trip the output.
+"""
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.obs.core import (
+    CounterKey,
+    _rt,
+    counters_snapshot,
+    spans_snapshot,
+    sync_reports,
+)
+
+_PROM_PREFIX = "metrics_tpu_"
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def report() -> Dict[str, Any]:
+    """Everything the runtime knows, as plain JSON-serializable data."""
+    counters = [
+        {"name": name, "labels": dict(labels), "value": value}
+        for (name, labels), value in sorted(counters_snapshot().items())
+    ]
+    spans = [
+        {
+            "name": name,
+            "labels": dict(labels),
+            "count": int(agg[0]),
+            "total_secs": round(agg[1], 6),
+            "max_secs": round(agg[2], 6),
+        }
+        for (name, labels), agg in sorted(spans_snapshot().items())
+    ]
+    with _rt.lock:
+        events = list(_rt.events)
+    return {
+        "enabled": _rt.enabled,
+        "counters": counters,
+        "spans": spans,
+        "sync_reports": sync_reports(),
+        "recent_events": events,
+    }
+
+
+def dump_json(path: str, indent: int = 2) -> str:
+    """Write ``report()`` to ``path``; returns the path for chaining."""
+    with open(path, "w") as fh:
+        json.dump(report(), fh, indent=indent, sort_keys=True, default=str)
+        fh.write("\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text format
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_PREFIX + _NAME_RE.sub("_", name)
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for key, value in labels:
+        escaped = value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        parts.append(f'{_NAME_RE.sub("_", key)}="{escaped}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _fmt(value: float) -> str:
+    if isinstance(value, float) and not value.is_integer():
+        return repr(round(value, 9))
+    return str(int(value))
+
+
+def prometheus_text() -> str:
+    """Render counters and span aggregates in Prometheus exposition format."""
+    lines: List[str] = []
+
+    by_name: Dict[str, List[Tuple[CounterKey, float]]] = {}
+    for key, value in sorted(counters_snapshot().items()):
+        by_name.setdefault(key[0], []).append((key, value))
+    for name, series in by_name.items():
+        prom = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {prom} counter")
+        for (_, labels), value in series:
+            lines.append(f"{prom}{_prom_labels(labels)} {_fmt(value)}")
+
+    spans = sorted(spans_snapshot().items())
+    if spans:
+        for suffix, idx, kind in (
+            ("span_count_total", 0, "counter"),
+            ("span_seconds_total", 1, "counter"),
+            ("span_seconds_max", 2, "gauge"),
+        ):
+            prom = _PROM_PREFIX + suffix
+            lines.append(f"# TYPE {prom} {kind}")
+            for (name, labels), agg in spans:
+                full = (("span", name),) + labels
+                lines.append(f"{prom}{_prom_labels(full)} {_fmt(agg[idx])}")
+
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Parse exposition-format lines back into {(name, labels): value}.
+
+    Understands the subset ``prometheus_text`` emits (no timestamps, no
+    exemplars) plus escaped label values; raises ValueError on malformed
+    lines so tests catch renderer drift.
+    """
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labels_src, _, value_src = rest.rpartition("} ")
+            if not _:
+                raise ValueError(f"malformed series line: {raw!r}")
+            labels = _parse_labels(labels_src)
+        else:
+            name, _, value_src = line.rpartition(" ")
+            labels = ()
+        if not name or not value_src:
+            raise ValueError(f"malformed series line: {raw!r}")
+        out[(name, labels)] = float(value_src)
+    return out
+
+
+def _parse_labels(src: str) -> Tuple[Tuple[str, str], ...]:
+    labels: List[Tuple[str, str]] = []
+    i, n = 0, len(src)
+    while i < n:
+        eq = src.index("=", i)
+        key = src[i:eq]
+        if src[eq + 1] != '"':
+            raise ValueError(f"unquoted label value near {src[i:]!r}")
+        j = eq + 2
+        buf: List[str] = []
+        while j < n:
+            ch = src[j]
+            if ch == "\\":
+                nxt = src[j + 1]
+                buf.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+                j += 2
+                continue
+            if ch == '"':
+                break
+            buf.append(ch)
+            j += 1
+        else:
+            raise ValueError(f"unterminated label value near {src[i:]!r}")
+        labels.append((key, "".join(buf)))
+        i = j + 1
+        if i < n and src[i] == ",":
+            i += 1
+    return tuple(labels)
+
+
+# ---------------------------------------------------------------------------
+# compact summaries (bench.py attribution sections)
+
+
+def summarize_counters(
+    counters: Optional[Dict[CounterKey, float]] = None,
+) -> Dict[str, Any]:
+    """Fold raw counters into the compact attribution dict bench.py embeds.
+
+    Accepts a snapshot (or a delta of two snapshots) from
+    ``counters_snapshot``; zero-valued sections are omitted so quiet configs
+    stay quiet in the output.
+    """
+    if counters is None:
+        counters = counters_snapshot()
+    recompiles = 0.0
+    by_metric: Dict[str, float] = {}
+    sync: Dict[str, float] = {}
+    iou_hits = iou_misses = 0.0
+    fallbacks = 0.0
+    faults = 0.0
+    suppressed = 0.0
+    for (name, labels), value in counters.items():
+        if not value:
+            continue
+        if name == "jit_traces":
+            recompiles += value
+            metric = dict(labels).get("metric", "?")
+            by_metric[metric] = by_metric.get(metric, 0) + value
+        elif name.startswith("sync."):
+            field = name[len("sync."):]
+            sync[field] = sync.get(field, 0) + value
+        elif name == "iou_cache.hits":
+            iou_hits += value
+        elif name == "iou_cache.misses":
+            iou_misses += value
+        elif name == "eager_fallback":
+            fallbacks += value
+        elif name == "chaos.faults":
+            faults += value
+        elif name == "warn_once.suppressed":
+            suppressed += value
+    out: Dict[str, Any] = {}
+    if recompiles:
+        out["recompiles"] = int(recompiles)
+        out["recompiles_by_metric"] = {k: int(v) for k, v in sorted(by_metric.items())}
+    if sync:
+        out["sync"] = {
+            k: (round(v, 6) if k == "backoff_secs" else int(v)) for k, v in sorted(sync.items())
+        }
+    if iou_hits or iou_misses:
+        out["iou_cache"] = {
+            "hits": int(iou_hits),
+            "misses": int(iou_misses),
+            "hit_rate": round(iou_hits / (iou_hits + iou_misses), 4),
+        }
+    if fallbacks:
+        out["eager_fallbacks"] = int(fallbacks)
+    if faults:
+        out["chaos_faults"] = int(faults)
+    if suppressed:
+        out["warnings_suppressed"] = int(suppressed)
+    return out

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from metrics_tpu.obs import core as _obs
 from metrics_tpu.utils.exceptions import SyncDesyncError, SyncError, SyncTimeoutError
 
 Array = jax.Array
@@ -185,7 +186,12 @@ def guarded_collective(
     last_error: Optional[BaseException] = None
     for attempt in range(attempts):
         if attempt:
-            time.sleep(options.backoff * (2 ** (attempt - 1)))
+            nap = options.backoff * (2 ** (attempt - 1))
+            time.sleep(nap)
+            if telemetry is not None:
+                telemetry["backoff_secs"] = round(telemetry.get("backoff_secs", 0.0) + nap, 6)
+        if telemetry is not None:
+            telemetry["attempts"] = telemetry.get("attempts", 0) + 1
         try:
             value = _call_with_deadline(fn, options.timeout, label)
         except SyncError:
@@ -423,12 +429,13 @@ class MultihostBackend(Backend):
         x = jnp.asarray(x)
         label = self._label or "gather"
         seq = next(_KV_SEQ)  # fixed per LOGICAL collective: retries reuse it
-        out = guarded_collective(
-            lambda: self._allgather(x, seq),
-            self.options,
-            label=label,
-            telemetry=self._telemetry,
-        )
+        with _obs.span("sync.collective", backend=type(self).__name__, state=label):
+            out = guarded_collective(
+                lambda: self._allgather(x, seq),
+                self.options,
+                label=label,
+                telemetry=self._telemetry,
+            )
         self._telemetry["gather_calls"] = self._telemetry.get("gather_calls", 0) + 1
         nbytes = getattr(out, "nbytes", 0)
         self._telemetry["bytes_gathered"] = self._telemetry.get("bytes_gathered", 0) + int(nbytes)
@@ -467,6 +474,8 @@ class MultihostBackend(Backend):
         import io
 
         from jax._src import distributed
+
+        _obs.counter_inc("sync.kv_fallback_gathers")
 
         client = distributed.global_state.client
         if client is None:

@@ -32,9 +32,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import core as _obs
 from metrics_tpu.parallel.backend import (
     Backend,
     SyncOptions,
@@ -135,11 +137,26 @@ class ChaosBackend(Backend):
             return idx, None, None
         kind, arg = (fault if isinstance(fault, tuple) else (fault, None))
         self.injected.append((idx, kind))
+        _obs.counter_inc("chaos.faults", kind=kind)
         return idx, kind, arg
 
     def _run(self, op: str, fn: Callable[[], Any]) -> Any:
         idx, kind, arg = self._next_fault()
-        return self._guarded(op, fn, idx, kind, arg)
+        value = self._guarded(op, fn, idx, kind, arg)
+        if not hasattr(self.inner, "_telemetry"):
+            # an inner MultihostBackend counts its own gathers/bytes; over a
+            # telemetry-less inner (NullBackend in CI) the chaos layer is the
+            # only place the per-collective figures can be observed
+            self._telemetry["gather_calls"] = self._telemetry.get("gather_calls", 0) + 1
+            nbytes = sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(value)
+            )
+            if nbytes:
+                self._telemetry["bytes_gathered"] = (
+                    self._telemetry.get("bytes_gathered", 0) + nbytes
+                )
+        return value
 
     def _guarded(self, op: str, fn: Callable[[], Any], idx: int, kind: Optional[str], arg: Any) -> Any:
         consumed = {"pending": kind}

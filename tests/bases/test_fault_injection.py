@@ -312,6 +312,30 @@ class TestChaosBackend:
         assert tel["faults_injected"] == 1
         assert cb.pop_telemetry()["faults_injected"] == 1  # log persists; counters reset
 
+    def test_faults_surface_as_obs_counters(self):
+        from metrics_tpu import obs
+
+        obs.reset()
+        cb = _chaos({0: ("delay", 1.0), 1: "corrupt"}, timeout=0.1, retries=1)
+        cb.pmean(jnp.ones(1))  # injects + consumes the delay fault via retry
+        cb.pmean(jnp.ones(1))  # corrupt fault
+        assert obs.counter_value("chaos.faults", kind="delay") == 1
+        assert obs.counter_value("chaos.faults", kind="corrupt") == 1
+        # and the attempt telemetry feeds the sync registry via the metric path
+        m = DummyMetricSum(
+            sync_backend=_chaos({0: ("delay", 1.0)}, timeout=0.1, retries=1)
+        )
+        m.update(1.0)
+        m.compute()
+        report = m.last_sync_report
+        assert report["attempts"] >= 2  # first attempt timed out, retry landed
+        assert report["backoff_secs"] > 0
+        assert obs.sync_reports("DummyMetricSum")[-1]["faults_injected"] == 1
+        summary = obs.summarize_counters()
+        assert summary["chaos_faults"] >= 3
+        assert summary["sync"]["reports"] >= 1
+        obs.reset()
+
 
 # ---------------------------------------------------------------- telemetry
 class TestLastSyncReport:

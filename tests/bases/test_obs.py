@@ -1,0 +1,298 @@
+"""Observability runtime tests: no-op guarantees, spans, counters, exporters.
+
+Every test runs against a freshly reset registry (autouse fixture below) and
+leaves tracing disabled, so this module cannot leak state into the rest of
+the suite.
+"""
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import MetricCollection, obs
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.obs import core as obs_core
+from metrics_tpu.obs.logging import warn_once
+from metrics_tpu.parallel import ChaosBackend, NullBackend, SyncOptions
+from metrics_tpu.regression import MeanSquaredError
+
+from tests.bases.dummies import DummyMetricSum
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    obs.disable()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+def _span_names(snapshot=None):
+    snapshot = snapshot if snapshot is not None else obs_core.spans_snapshot()
+    return sorted({name for (name, _labels) in snapshot})
+
+
+def _chaos_metric(**kwargs):
+    return DummyMetricSum(
+        sync_backend=ChaosBackend(
+            NullBackend(), world_size=2, options=SyncOptions(timeout=None)
+        ),
+        **kwargs,
+    )
+
+
+# ------------------------------------------------------------- disabled mode
+class TestDisabledNoOp:
+    def test_span_returns_shared_noop_singleton(self):
+        assert obs.span("anything", metric="X") is obs_core.NOOP_SPAN
+        # and the singleton is inert: enter/exit/set record nothing
+        with obs.span("anything") as s:
+            s.set(extra=1)
+        assert obs_core.spans_snapshot() == {}
+
+    def test_metric_use_records_no_spans(self):
+        m = Accuracy(num_classes=3, validate_args=False)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        m.compute()
+        assert obs_core.spans_snapshot() == {}
+
+    def test_counters_still_tick_while_disabled(self):
+        # counters are the always-on tier: trace counting works without enable()
+        m = DummyMetricSum()
+        m.update(1.0)
+        m._flush_pending()
+        assert obs.counter_value("jit_traces", metric="DummyMetricSum", fn="update") >= 1
+
+    def test_enabled_flag_roundtrip(self):
+        assert not obs.enabled()
+        obs.enable()
+        assert obs.enabled()
+        assert not isinstance(obs.span("x"), obs_core._NoopSpan)
+        obs.disable()
+        assert obs.span("x") is obs_core.NOOP_SPAN
+
+
+# ----------------------------------------------------------- spans + nesting
+class TestSpans:
+    def test_metric_update_and_compute_spanned(self):
+        obs.enable()
+        m = Accuracy(num_classes=3, validate_args=False)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        m.compute()
+        names = _span_names()
+        assert "metric.update" in names
+        assert "metric.compute" in names
+
+    def test_collection_compute_attributes_members_as_parents(self):
+        obs.enable()
+        mc = MetricCollection(
+            {"acc": Accuracy(num_classes=3, validate_args=False), "mse": MeanSquaredError()},
+            compute_groups=False,
+        )
+        mc.update(jnp.asarray([0.0, 1.0, 2.0]), jnp.asarray([0.0, 1.0, 1.0]))
+        mc.compute()
+        spans = obs_core.spans_snapshot()
+        member_updates = [
+            dict(labels)
+            for (name, labels) in spans
+            if name == "metric.update"
+        ]
+        # both members' update spans nest under the collection span
+        assert {d.get("metric") for d in member_updates} >= {"Accuracy", "MeanSquaredError"}
+        assert all(d.get("parent") == "collection.update" for d in member_updates)
+        member_computes = [
+            dict(labels) for (name, labels) in spans if name == "metric.compute"
+        ]
+        assert all(d.get("parent") == "collection.compute" for d in member_computes)
+
+    def test_collection_forward_spanned(self):
+        obs.enable()
+        mc = MetricCollection(
+            {"acc": Accuracy(num_classes=3, validate_args=False)}, compute_groups=False
+        )
+        mc(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        assert "collection.forward" in _span_names()
+
+    def test_span_aggregates_count_total_max(self):
+        obs.enable()
+        for _ in range(3):
+            with obs.span("unit.test", case="agg"):
+                pass
+        ((name, labels), agg), = [
+            item for item in obs_core.spans_snapshot().items() if item[0][0] == "unit.test"
+        ]
+        assert agg[0] == 3
+        assert agg[1] >= agg[2] >= 0  # total >= max
+
+
+# ------------------------------------------------------ recompile attribution
+class TestRecompileCounters:
+    def test_shape_churn_counts_retraces(self):
+        m = Accuracy(num_classes=3, validate_args=False)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        m._flush_pending()
+        first = obs.counter_value("jit_traces", metric="Accuracy", fn="update")
+        assert first >= 1
+        # same shape again: cache hit, no new trace
+        m.update(jnp.asarray([1, 2, 0]), jnp.asarray([1, 2, 0]))
+        m._flush_pending()
+        assert obs.counter_value("jit_traces", metric="Accuracy", fn="update") == first
+        # new shape: retrace observed
+        m.update(jnp.asarray([0, 1, 2, 0, 1]), jnp.asarray([0, 1, 1, 0, 1]))
+        m._flush_pending()
+        assert obs.counter_value("jit_traces", metric="Accuracy", fn="update") > first
+
+    def test_summarize_counters_groups_by_metric(self):
+        m = DummyMetricSum()
+        m.update(1.0)
+        m._flush_pending()
+        summary = obs.summarize_counters()
+        assert summary["recompiles"] >= 1
+        assert "DummyMetricSum" in summary["recompiles_by_metric"]
+
+
+# ------------------------------------------------------------------ exporters
+class TestExporters:
+    def test_report_contains_all_sections(self):
+        obs.enable()
+        m = _chaos_metric()
+        m.update(1.0)
+        m.compute()
+        rep = obs.report()
+        assert rep["enabled"] is True
+        names = {c["name"] for c in rep["counters"]}
+        assert "jit_traces" in names and "sync.reports" in names
+        assert {s["name"] for s in rep["spans"]} >= {"metric.update", "metric.compute", "metric.sync"}
+        assert rep["sync_reports"] and rep["sync_reports"][-1]["metric"] == "DummyMetricSum"
+        assert rep["recent_events"]
+
+    def test_prometheus_round_trip(self):
+        obs.enable()
+        m = _chaos_metric()
+        m.update(1.0)
+        m.compute()
+        obs.counter_inc("weird.name", 2, label_with="quote\"back\\slash\nnewline")
+        text = obs.prometheus_text()
+        parsed = obs.parse_prometheus_text(text)
+        assert parsed  # non-empty
+        # every counter survives the round trip, prefixed and suffixed
+        for (name, labels), value in obs.counters_snapshot().items():
+            prom = "metrics_tpu_" + name.replace(".", "_") + "_total"
+            sanitized = tuple((k, str(v)) for k, v in labels)
+            assert parsed[(prom, sanitized)] == pytest.approx(value)
+        # span series present with the span= label
+        span_series = [k for k in parsed if k[0] == "metrics_tpu_span_count_total"]
+        assert span_series
+        assert all(dict(labels).get("span") for _, labels in span_series)
+
+    def test_parse_rejects_malformed_lines(self):
+        with pytest.raises(ValueError):
+            obs.parse_prometheus_text("metrics_tpu_x_total{a=unquoted} 1")
+        with pytest.raises(ValueError):
+            obs.parse_prometheus_text('metrics_tpu_x_total{a="unterminated} 1')
+
+    def test_dump_json_writes_valid_report(self, tmp_path):
+        obs.enable()
+        m = Accuracy(num_classes=3, validate_args=False)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        m.compute()
+        path = tmp_path / "obs.json"
+        assert obs.dump_json(str(path)) == str(path)
+        data = json.loads(path.read_text())
+        assert data["enabled"] is True
+        assert any(s["name"] == "metric.update" for s in data["spans"])
+
+    def test_summarize_counters_accepts_delta(self):
+        obs.counter_inc("jit_traces", 2, metric="A", fn="update")
+        before = obs.counters_snapshot()
+        obs.counter_inc("jit_traces", 3, metric="A", fn="update")
+        after = obs.counters_snapshot()
+        delta = {k: v - before.get(k, 0) for k, v in after.items() if v != before.get(k, 0)}
+        assert obs.summarize_counters(delta) == {
+            "recompiles": 3,
+            "recompiles_by_metric": {"A": 3},
+        }
+
+
+# ------------------------------------------------------------------ warn_once
+class TestWarnOnce:
+    def test_emits_once_then_suppresses_and_counts(self):
+        with pytest.warns(UserWarning, match="thing happened"):
+            assert warn_once("thing happened", key="test.thing") is True
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # a second emission would raise
+            assert warn_once("thing happened", key="test.thing") is False
+            assert warn_once("thing happened", key="test.thing") is False
+        assert obs.counter_value("warn_once.suppressed", site="test.thing") == 2
+        assert obs.counter_value("warn_once.emitted", site="test.thing") == 1
+
+    def test_distinct_keys_warn_independently(self):
+        with pytest.warns(UserWarning):
+            warn_once("msg", key="test.k1")
+        with pytest.warns(UserWarning):
+            warn_once("msg", key="test.k2")
+
+    def test_reset_clears_dedup_registry(self):
+        with pytest.warns(UserWarning):
+            warn_once("again", key="test.reset")
+        obs.reset()
+        with pytest.warns(UserWarning):
+            warn_once("again", key="test.reset")
+
+    def test_r2_degenerate_routes_through_warn_once(self):
+        from metrics_tpu.functional.regression.r2 import r2_score
+
+        preds = jnp.asarray([1.0, 2.0, 3.0])
+        target = jnp.asarray([1.0, 2.0, 3.0])
+        with pytest.warns(UserWarning, match="More independent regressions"):
+            r2_score(preds, target, adjusted=5)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            r2_score(preds, target, adjusted=5)  # second call: suppressed
+        assert obs.counter_value("warn_once.suppressed", site="r2.adjusted_degenerate") == 1
+
+
+# -------------------------------------------------------- sync-report history
+class TestSyncReportHistory:
+    def test_history_ring_bounded_at_16(self):
+        m = _chaos_metric()
+        for i in range(20):
+            m.update(float(i))
+            m.compute()
+            m._computed = None
+        assert len(m.sync_report_history) == 16
+        assert m.sync_report_history[-1] == m.last_sync_report
+
+    def test_registry_queryable_by_metric(self):
+        m = _chaos_metric()
+        m.update(1.0)
+        m.compute()
+        reports = obs.sync_reports("DummyMetricSum")
+        assert reports and reports[-1]["backend"] == "ChaosBackend"
+        assert obs.sync_reports("NoSuchMetric") == []
+        assert obs.counter_value("sync.reports", metric="DummyMetricSum") == 1
+
+    def test_collection_aggregate_sync_report(self):
+        def backend():
+            return ChaosBackend(NullBackend(), world_size=2, options=SyncOptions(timeout=None))
+
+        mc = MetricCollection(
+            {
+                "a": DummyMetricSum(sync_backend=backend()),
+                "b": DummyMetricSum(sync_backend=backend()),
+            },
+            compute_groups=False,
+        )
+        mc.update(2.0)
+        mc.compute()
+        agg = mc.aggregate_sync_report()
+        assert agg["members_reporting"] == 2
+        assert agg["gather_calls"] > 0
+        assert agg["bytes_gathered"] > 0
+        assert agg["errors"] == []
+        history = mc.sync_report_history
+        assert set(history) == {"a", "b"}
+        assert all(len(v) == 1 for v in history.values())

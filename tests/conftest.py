@@ -48,3 +48,17 @@ THRESHOLD = 0.5
 def _seed():
     np.random.seed(42)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _warn_once_isolation():
+    """Clear the process-wide warn-once registry between tests.
+
+    warn_once dedups per process; without this, whichever test first triggers
+    a degenerate-input warning would swallow it for every later test that
+    asserts on it (order-dependent flakiness under pytest-randomly).
+    """
+    yield
+    from metrics_tpu.obs.logging import _clear
+
+    _clear()

@@ -1,0 +1,12 @@
+"""Tier-1 gate: no Metric subclass may shadow the instrumented base-class path."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from obs_lint import lint  # noqa: E402
+
+
+def test_all_metric_subclasses_on_instrumented_path():
+    assert lint() == []
