@@ -111,16 +111,23 @@ def _np_accuracy_batches(n_batches):
 _N_LOOPED = 4000  # large enough to amortize tunnel round-trip variance (~0.1-0.5s)
 
 
-def _measure_h2d_bandwidth(mb=8):
+def _measure_h2d_bandwidth(mb=256):
     """Host->device transfer bandwidth (tiny through the axon tunnel; GB/s on
     a co-located host).  Reported so the looped numbers are interpretable:
-    any host-resident workload is bounded by this, not by the framework."""
+    any host-resident workload is bounded by this, not by the framework.
+
+    The buffer must be large enough to amortize dispatch/launch overhead,
+    and the clock must stop only after ``block_until_ready`` — ``float(d[0])``
+    on a small buffer times the dispatch path, not the transfer.
+    """
     import jax.numpy as jnp
 
+    # warm the dispatch path so setup cost stays out of the measured window
+    jnp.asarray(np.ones((1024,), np.float32)).block_until_ready()
     x = np.ones((mb * 1024 * 1024 // 4,), np.float32)
     start = time.perf_counter()
     d = jnp.asarray(x)
-    float(d[0])
+    d.block_until_ready()
     return x.nbytes / 1e6 / (time.perf_counter() - start)
 
 
@@ -495,6 +502,7 @@ def _bench_detection_ddp(nproc=2, n_batches=6, batch_size=8):
         )
     elapsed, ok = 0.0, 0
     first_step, last_step = 0.0, 0.0
+    sync_counters: dict = {}
     try:
         for p in procs:
             out, _ = p.communicate(timeout=600)
@@ -506,6 +514,11 @@ def _bench_detection_ddp(nproc=2, n_batches=6, batch_size=8):
                     if len(parts) > 3:
                         first_step = max(first_step, float(parts[2]))
                         last_step = max(last_step, float(parts[3]))
+                elif line.startswith("MAP_DDP_OBS"):
+                    # workers are symmetric: keep the max across ranks
+                    for field in line.split()[1:]:
+                        key, _, val = field.partition("=")
+                        sync_counters[key] = max(sync_counters.get(key, 0), int(val))
     finally:
         for p in procs:  # a hung worker must not outlive the bench
             if p.poll() is None:
@@ -515,15 +528,15 @@ def _bench_detection_ddp(nproc=2, n_batches=6, batch_size=8):
     profile = {
         "first_step_secs": round(first_step, 4),
         "last_step_secs": round(last_step, 4),
-        # dist_sync_on_step semantics: every forward all-gathers the FULL
-        # accumulated state across processes and reruns compute on the union,
-        # so per-step matching/table cost grows through the epoch — but the
-        # IoU blocks themselves come from the content cache after the first
-        # step, so the growth is in the (cheaper) match/tables stages; both
-        # workers also share this host's single core, so the absolute rate
-        # moves with box contention (the round-3 7.1 img/s reading vs
-        # round-2's 18.9 was contention, not a regression)
-        "note": "per-step sync reruns match/tables over all accumulated images (IoU blocks content-cached); 2 CPU workers share 1 core",
+        "sync_counters": sync_counters,
+        # dist_sync_on_step per-step cost is dominated by sync round trips,
+        # not payload: each forward syncs only the BATCH state (one packed
+        # blob exchange), and the batch gather advances the delta-sync
+        # prefix so the epoch-end compute ships only the un-gathered tail;
+        # IoU blocks come from the content cache after the first step; both
+        # workers share this host's single core, so the absolute rate moves
+        # with box contention
+        "note": "per-step sync ships one packed batch blob; delta prefix advances per step (sync_counters); 2 CPU workers share 1 core",
     }
     return (nproc * n_batches * batch_size) / elapsed, profile
 
@@ -855,6 +868,16 @@ def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     elapsed = time.perf_counter() - start
     first, last = step_times[0], step_times[-1]
     print(f"MAP_DDP_OK {elapsed:.6f} {first:.6f} {last:.6f}", flush=True)
+    # per-worker sync telemetry for the parent's compact line: how much of
+    # the step loop ran on delta gathers and what the prefix cache saved
+    from metrics_tpu.obs import counters_snapshot, summarize_counters
+
+    sync = summarize_counters(counters_snapshot()).get("sync", {})
+    fields = " ".join(
+        f"{key}={int(sync.get(key, 0))}"
+        for key in ("delta_syncs", "full_syncs", "bytes_saved", "bytes_gathered")
+    )
+    print(f"MAP_DDP_OBS {fields}", flush=True)
 
 
 def _obs_counters():
@@ -914,6 +937,7 @@ def main() -> None:
         # by this transfer rate (tiny through the axon tunnel), not by the
         # framework — the looped configs therefore use device-resident inputs
         extra["h2d_bandwidth_mb_per_sec"] = round(_measure_h2d_bandwidth(), 1)
+        extra["h2d_bandwidth_buffer_mb"] = 256  # result is meaningless without the size
     except Exception:
         extra["h2d_bandwidth_mb_per_sec"] = None
     for name, fn in (
@@ -935,6 +959,11 @@ def main() -> None:
             elif name.startswith("config5_map_ddp"):
                 extra[name] = round(result[0], 1)
                 extra["config5_map_ddp_profile"] = result[1]
+                # subprocess counters never reach this process's obs registry;
+                # lift them to scalars so the compact line (which drops nested
+                # dicts) still carries the delta-sync telemetry
+                for key, val in (result[1].get("sync_counters") or {}).items():
+                    extra[f"config5_map_ddp_sync_{key}"] = val
             elif name.startswith("config5_map_coco_scale"):
                 extra[name] = round(result[0], 1)
                 extra["config5_map_coco_scale_profile"] = result[1]

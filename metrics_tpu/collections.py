@@ -474,6 +474,10 @@ class MetricCollection:
                         member._refresh_buffer_meta(bname)
                 member._update_count = leader._update_count
                 member._computed = None
+                # shared states must share ONE synced watermark: a member
+                # syncing through its own cache would splice the leader's
+                # prefix at the wrong row
+                member._delta_cache = leader._delta_cache
 
     def compute(self) -> Dict[str, Any]:
         if _OBS_RT.enabled:
@@ -593,6 +597,9 @@ class MetricCollection:
             "attempts": 0,
             "gather_calls": 0,
             "bytes_gathered": 0,
+            "bytes_saved": 0,
+            "delta_syncs": 0,
+            "full_syncs": 0,
             "backoff_secs": 0.0,
             "errors": [],
         }
@@ -607,8 +614,10 @@ class MetricCollection:
             totals["backoff_secs"] = round(
                 totals["backoff_secs"] + float(rep.get("backoff_secs") or 0.0), 6
             )
-            for key in ("retries", "attempts", "gather_calls", "bytes_gathered"):
+            for key in ("retries", "attempts", "gather_calls", "bytes_gathered", "bytes_saved"):
                 totals[key] += int(rep.get(key) or 0)
+            if "delta" in rep:
+                totals["delta_syncs" if rep["delta"] else "full_syncs"] += 1
             if rep.get("error"):
                 totals["errors"].append({"member": name, "error": rep["error"]})
         return totals

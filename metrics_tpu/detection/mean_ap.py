@@ -219,6 +219,10 @@ class MeanAveragePrecision(Metric):
     full_state_update = True
     jit_update_default = False
     jit_compute_default = False
+    # update() appends one entry per list state per call, independent of
+    # accumulated state — so the dist_sync_on_step batch gather can advance
+    # the delta-sync prefix and the epoch-end compute() ships only the tail
+    _forward_delta_advance = True
 
     def __init__(
         self,

@@ -25,8 +25,10 @@ TPU-first redesign (SURVEY.md §7 delta 1):
 
 import copy
 import functools
+import hashlib
 import numbers
 import os
+import struct
 import time
 import warnings
 from abc import ABC, abstractmethod
@@ -58,6 +60,102 @@ _OBS_RT = _obs._rt
 _ALLOWED_REDUCE = ("sum", "mean", "max", "min", "cat")
 
 _FUSED_FORWARD_FAILED = object()  # sentinel: fused forward could not trace
+
+_UNSET = object()  # sentinel: distinguish "no saved value" from a None value
+
+
+def _rows_of(x: Any) -> int:
+    """Leading-axis row count under ``dim_zero_cat`` semantics (0-d == 1 row)."""
+    return int(np.shape(x)[0]) if np.ndim(x) >= 1 else 1
+
+
+class _DeltaCache:
+    """Per-metric cache of the previously gathered cat/list state.
+
+    ``prefixes[name]`` holds the last *globally gathered* value for a
+    cat-like state (identical on every rank — it is the collective's
+    result), ``watermarks[name]`` the number of *local* rows that prefix
+    covers on this rank.  A sync with a live cache gathers only the rows
+    past the watermark and splices them onto the prefix, turning a K-step
+    streaming sync loop from O(K²) to O(K) wire bytes.
+
+    ``round`` encodes trust: ``0`` means no verified prefix (the next sync
+    must be a full gather); ``N >= 1`` means the prefix came out of round N
+    and every rank that agrees on ``N`` holds the identical prefix — full
+    gathers reset the induction at 1, each delta sync increments it.  The
+    pre-flight vote compares ``(round, digest(state names))`` across ranks;
+    any disagreement, or any rank with a cleared cache, forces the whole
+    fleet back to a full gather.  Correctness never rests on the cache:
+    clearing it anywhere, any time, only costs one full re-gather.
+
+    Compute-group members of a :class:`MetricCollection` alias ONE cache
+    object (their states are shared, so their watermarks must be too) —
+    which is why :meth:`clear` empties in place rather than rebinding.
+    """
+
+    def __init__(self) -> None:
+        self.prefixes: Dict[str, Any] = {}
+        self.watermarks: Dict[str, int] = {}
+        self.round = 0
+
+    def clear(self) -> None:
+        self.prefixes.clear()
+        self.watermarks.clear()
+        self.round = 0
+
+    def token(self, names: Sequence[str]) -> Tuple[int, int, int]:
+        """``(round, digest_lo, digest_hi)`` int32-safe vote token.
+
+        Digests only the participating state *names*: watermark values are
+        per-rank local row counts and legitimately differ across uneven
+        shards, so they must stay out of the agreement check.
+        """
+        h = hashlib.blake2b("\x1f".join(sorted(names)).encode(), digest_size=8).digest()
+        lo = int.from_bytes(h[:4], "little") & 0x7FFFFFFF
+        hi = int.from_bytes(h[4:], "little") & 0x7FFFFFFF
+        return (self.round & 0x7FFFFFFF, lo, hi)
+
+
+def _pack_state_blob(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize named arrays into one self-describing byte blob.
+
+    Dtypes are recorded by name (``'bfloat16'`` round-trips through the
+    ml_dtypes registry, which ``np.save`` cannot do), so the packed sync
+    path can ship any state the per-state path can.
+    """
+    parts = [struct.pack("<I", len(arrays))]
+    for key in sorted(arrays):
+        # NOT ascontiguousarray: it promotes 0-d to 1-d, and tobytes()
+        # produces C-order bytes for any layout anyway
+        arr = np.asarray(arrays[key])
+        kb, db, raw = key.encode(), arr.dtype.name.encode(), arr.tobytes()
+        parts.append(struct.pack("<HHB", len(kb), len(db), arr.ndim))
+        parts.append(kb)
+        parts.append(db)
+        parts.append(struct.pack(f"<{arr.ndim}q", *arr.shape))
+        parts.append(struct.pack("<q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _unpack_state_blob(blob: bytes) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    off = 4
+    (n,) = struct.unpack_from("<I", blob, 0)
+    for _ in range(n):
+        klen, dlen, ndim = struct.unpack_from("<HHB", blob, off)
+        off += 5
+        key = blob[off : off + klen].decode()
+        off += klen
+        dt = np.dtype(blob[off : off + dlen].decode())
+        off += dlen
+        shape = struct.unpack_from(f"<{ndim}q", blob, off)
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<q", blob, off)
+        off += 8
+        out[key] = np.frombuffer(blob, dt, count=nbytes // dt.itemsize, offset=off).reshape(shape)
+        off += nbytes
+    return out
 
 
 def _merge_tensor_state(fx: Any, global_val: Array, local_val: Array, global_count) -> Array:
@@ -171,6 +269,13 @@ class Metric(ABC):
             through, overriding autodetection — the hook
             :class:`~metrics_tpu.parallel.ChaosBackend` uses for fault
             injection.
+        delta_sync: incremental cross-host sync for append-only (``cat`` /
+            list) states — after a successful full gather, later syncs ship
+            only the rows appended since the previous one and splice them
+            onto the cached gathered prefix, guarded by a collective vote in
+            the pre-flight exchange (any disagreement falls back to a full
+            gather).  Default on; env kill switch
+            ``METRICS_TPU_DELTA_SYNC=0``.  See ``docs/fault_tolerance.md``.
     """
 
     __jit_state_unsafe__ = False  # set True on metrics whose update cannot trace
@@ -221,6 +326,13 @@ class Metric(ABC):
             os.environ.get("METRICS_TPU_VALIDATE_SYNC", "").strip().lower() in ("1", "true", "yes"),
         )
         self.sync_backend = kwargs.pop("sync_backend", None)
+        self.delta_sync = kwargs.pop(
+            "delta_sync",
+            os.environ.get("METRICS_TPU_DELTA_SYNC", "").strip().lower()
+            not in ("0", "false", "no"),
+        )
+        self._delta_cache = _DeltaCache()
+        self._last_synced_state: Optional[Dict[str, Any]] = None
         self.last_sync_report: Optional[Dict[str, Any]] = None
         # bounded per-metric ring of recent sync reports (newest last); the
         # process-wide view lives in the obs registry (obs.sync_reports())
@@ -616,43 +728,68 @@ class Metric(ABC):
         value, _ = self._run_with_state(state, self._compute_impl, (), {})
         return value
 
-    def merge_state(self, other_state: Dict[str, Any], other_count: Optional[int] = None) -> None:
-        """Fold another instance's state into this one (host-side tree-merge).
+    def merge_state(
+        self,
+        other_state: Union[Dict[str, Any], Sequence[Dict[str, Any]]],
+        other_count: Optional[Union[int, Sequence[int]]] = None,
+    ) -> None:
+        """Fold other instances' state into this one (host-side tree-merge).
 
         Args:
-            other_state: the other instance's state pytree.
-            other_count: the other instance's ``update_count``.  When given,
-                ``mean`` states merge count-weighted — exact for shards that
-                saw unequal numbers of batches.  When omitted, ``mean`` falls
-                back to the unweighted two-way average (the reference's
-                stack->mean has the same equal-shard assumption).
+            other_state: another instance's state pytree, or a sequence of
+                them.  A sequence merges in a single pass — ONE concatenate
+                per cat/buffer state — instead of the quadratic copying a
+                per-shard ``merge_state`` loop pays.
+            other_count: the other instance's ``update_count`` (one per state
+                pytree when a sequence is given).  When given, ``mean``
+                states merge count-weighted — exact for shards that saw
+                unequal numbers of batches.  When omitted, ``mean`` falls
+                back to the unweighted average (the reference's stack->mean
+                has the same equal-shard assumption).
         """
         self._flush_pending()
         self._flush_host_buffers()
-        if other_count is not None:
-            mine, theirs = float(self._update_count), float(other_count)
-            total = mine + theirs
-            w_a = mine / total if total else 0.5
-            w_b = theirs / total if total else 0.5
+        if isinstance(other_state, dict):
+            others = [dict(other_state)]
         else:
-            w_a = w_b = 0.5
-        other_state = dict(other_state)
+            others = [dict(s) for s in other_state]
+        if other_count is None:
+            counts: Optional[List[float]] = None
+        elif isinstance(other_count, (list, tuple)):
+            counts = [float(c) for c in other_count]
+        else:
+            counts = [float(other_count)]
+        if counts is not None and len(counts) != len(others):
+            raise ValueError(
+                f"`other_count` has {len(counts)} entries for {len(others)} state pytrees"
+            )
+        if counts is not None:
+            total = float(self._update_count) + sum(counts)
+            weights = (
+                [float(self._update_count) / total] + [c / total for c in counts]
+                if total
+                else [1.0 / (1 + len(others))] * (1 + len(others))
+            )
+        else:
+            weights = [1.0 / (1 + len(others))] * (1 + len(others))
         skip_keys = set()
         for bname in self._buffer_states:
             bkey, lkey = bname + "__buf", bname + "__len"
             if bkey not in self._state:
                 continue
-            mine = self._extract_buffer_values(self._state, bname)
-            theirs = self._extract_buffer_values(other_state, bname)
-            if mine.shape[0] == 0 and (mine.ndim != theirs.ndim or mine.dtype != theirs.dtype):
-                # self never appended: its buffer is the (0,)-float32
-                # placeholder, whose rank/dtype must not leak into the merge
-                self._state[bkey] = theirs
-            elif theirs.shape[0] == 0:
-                self._state[bkey] = mine
+            parts = [self._extract_buffer_values(self._state, bname)] + [
+                self._extract_buffer_values(s, bname) for s in others
+            ]
+            # empty buffers are the (0,)-float32 placeholder, whose rank/dtype
+            # must not leak into the merge
+            filled = [p for p in parts if p.shape[0]]
+            if not filled:
+                self._state[bkey] = parts[0]
+            elif len(filled) == 1:
+                self._state[bkey] = filled[0]
             else:
-                dt = jnp.promote_types(mine.dtype, theirs.dtype)
-                self._state[bkey] = jnp.concatenate([mine.astype(dt), theirs.astype(dt)], axis=0)
+                dt = functools.reduce(jnp.promote_types, (p.dtype for p in filled))
+                self._state[bkey] = jnp.concatenate([p.astype(dt) for p in filled], axis=0)
             self._state[lkey] = int(self._state[bkey].shape[0])
             self._refresh_buffer_meta(bname)
             skip_keys.update((bkey, lkey))
@@ -660,39 +797,52 @@ class Metric(ABC):
         for name, value in self._state.items():
             if name in skip_keys:
                 continue
-            other = other_state[name]
+            parts = [value] + [s[name] for s in others]
             fx = self._reduce_fns[name]
             if isinstance(value, list):
-                merged[name] = list(value) + list(other)
-            elif fx is None:
-                # no reduction declared: keep both contributions (gather-style),
-                # matching the sync path's all-gather semantics
-                merged[name] = jnp.concatenate(
-                    [jnp.atleast_1d(value), jnp.atleast_1d(other)], axis=0
-                )
+                out: List[Any] = []
+                for p in parts:
+                    out.extend(p)
+                merged[name] = out
+            elif fx is None or fx == "cat":
+                # fx None: no reduction declared — keep every contribution
+                # (gather-style), matching the sync path's all-gather semantics
+                merged[name] = jnp.concatenate([jnp.atleast_1d(p) for p in parts], axis=0)
             elif fx == "sum":
-                merged[name] = value + other
+                merged[name] = functools.reduce(lambda a, b: a + b, parts)
             elif fx == "mean":
-                merged[name] = w_a * value + w_b * other
+                merged[name] = functools.reduce(
+                    lambda a, b: a + b, (w * p for w, p in zip(weights, parts))
+                )
             elif fx == "max":
-                merged[name] = jnp.maximum(value, other)
+                merged[name] = functools.reduce(jnp.maximum, parts)
             elif fx == "min":
-                merged[name] = jnp.minimum(value, other)
-            elif fx == "cat":
-                merged[name] = jnp.concatenate([jnp.atleast_1d(value), jnp.atleast_1d(other)], axis=0)
+                merged[name] = functools.reduce(jnp.minimum, parts)
             elif callable(fx):
-                merged[name] = fx(jnp.stack([value, other]))
+                merged[name] = fx(jnp.stack(parts))
             else:
                 raise ValueError(f"cannot merge state {name!r} with reduce {fx!r}")
         self._state.update(merged)
-        if other_count is not None:
-            self._update_count += int(other_count)
+        if counts is not None:
+            self._update_count += int(sum(counts))
         self._computed = None
+        # merged-in rows were never part of a gathered prefix
+        self._delta_cache.clear()
 
-    def _sync_state_pure(self, state: Dict[str, Any], backend: Backend) -> Dict[str, Any]:
+    def _sync_state_pure(
+        self,
+        state: Dict[str, Any],
+        backend: Backend,
+        delta_plan: Optional[Dict[str, tuple]] = None,
+    ) -> Dict[str, Any]:
         import jax.core
 
         state = dict(state)
+        delta_plan = delta_plan or {}
+        if getattr(backend, "supports_packed", False) and not any(
+            isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(state)
+        ):
+            return self._sync_state_packed(state, backend, delta_plan)
         out: Dict[str, Any] = {}
         try:
             for bname in self._buffer_states:
@@ -728,14 +878,107 @@ class Metric(ABC):
                         if not value:
                             out[name] = value
                             continue
-                        value = dim_zero_cat(value)
-                        out[name] = backend.all_gather_cat(value)
+                        value = jnp.atleast_1d(dim_zero_cat(value))
+                        if name in delta_plan:
+                            out[name] = self._splice_prefix(
+                                name, backend.all_gather_cat(value[delta_plan[name][-1] :])
+                            )
+                        else:
+                            out[name] = backend.all_gather_cat(value)
+                    elif name in delta_plan:
+                        value = jnp.atleast_1d(value)
+                        out[name] = self._splice_prefix(
+                            name, backend.all_gather_cat(value[delta_plan[name][-1] :])
+                        )
                     else:
                         out[name] = reduce_synced_state(value, fx, backend)
         except SyncTimeoutError as err:
             # per-state progress: which states HAD completed before the straggler
             err.synced_states = sorted(k for k in out if not k.endswith("__len"))
             raise
+        return out
+
+    def _sync_state_packed(
+        self, state: Dict[str, Any], backend: Backend, delta_plan: Dict[str, tuple]
+    ) -> Dict[str, Any]:
+        """Whole-state sync over ONE byte-blob gather.
+
+        Serializes this rank's entire contribution (delta-sliced where the
+        plan allows) into a single packed payload and exchanges it via
+        ``backend.all_gather_bytes`` — two collectives total instead of two
+        *per state*, which is what dominates sync latency on the KV-store
+        DCN path.  The local reassembly mirrors the per-state collective
+        math exactly: concat for buffers/cat, stack+reduce for scalars.
+        """
+        payload: Dict[str, np.ndarray] = {}
+        out: Dict[str, Any] = {}
+        buffer_names: List[str] = []
+        cat_names: List[str] = []
+        reduce_names: List[str] = []
+        for bname in self._buffer_states:
+            bkey, lkey = bname + "__buf", bname + "__len"
+            if bkey not in state:
+                continue
+            buf, cnt = state.pop(bkey), state.pop(lkey)
+            payload["b." + bname] = np.asarray(
+                self._extract_buffer_values({bkey: buf, lkey: cnt}, bname)
+            )
+            buffer_names.append(bname)
+        for name, value in state.items():
+            fx = self._reduce_fns[name]
+            if isinstance(value, list):
+                if not value:
+                    # preflight's "list:empty" signature guarantees every rank
+                    # agrees this state is empty — nothing to exchange
+                    out[name] = value
+                    continue
+                rows = jnp.atleast_1d(dim_zero_cat(value))
+                if name in delta_plan:
+                    rows = rows[delta_plan[name][-1] :]
+                payload["c." + name] = np.asarray(rows)
+                cat_names.append(name)
+            elif fx == "cat" or fx is None:
+                rows = jnp.atleast_1d(value)
+                if name in delta_plan:
+                    rows = rows[delta_plan[name][-1] :]
+                payload["c." + name] = np.asarray(rows)
+                cat_names.append(name)
+            else:
+                payload["r." + name] = np.asarray(value)
+                reduce_names.append(name)
+        try:
+            with backend.annotate("packed"):
+                shards = backend.all_gather_bytes(_pack_state_blob(payload))
+        except SyncTimeoutError as err:
+            err.synced_states = []  # all-or-nothing: nothing landed
+            raise
+        per_rank = [_unpack_state_blob(s) for s in shards]
+
+        def cat_ranks(key: str) -> Array:
+            parts = [r[key] for r in per_rank]
+            filled = [p for p in parts if p.shape[0]]
+            return jnp.asarray(np.concatenate(filled, axis=0) if filled else parts[0])
+
+        for bname in buffer_names:
+            gathered = cat_ranks("b." + bname)
+            out[bname + "__buf"] = gathered
+            out[bname + "__len"] = int(gathered.shape[0])
+        for name in cat_names:
+            gathered = cat_ranks("c." + name)
+            out[name] = self._splice_prefix(name, gathered) if name in delta_plan else gathered
+        for name in reduce_names:
+            fx = self._reduce_fns[name]
+            stacked = jnp.asarray(np.stack([r["r." + name] for r in per_rank]))
+            if fx == "sum":
+                out[name] = jnp.sum(stacked, axis=0)
+            elif fx == "mean":
+                out[name] = jnp.mean(stacked, axis=0)
+            elif fx == "max":
+                out[name] = jnp.max(stacked, axis=0)
+            elif fx == "min":
+                out[name] = jnp.min(stacked, axis=0)
+            else:
+                out[name] = fx(stacked)
         return out
 
     # ---------------------------------------------------------------- update
@@ -1368,23 +1611,43 @@ class Metric(ABC):
         """
         self.reset()
 
+    # set True on metrics whose per-batch appends are state-independent
+    # (re-running update on a reset state appends the same rows): lets the
+    # dist_sync_on_step batch gather advance the delta cache for free
+    _forward_delta_advance = False
+
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         self._update_now(*args, **kwargs)
         cache = self._copy_state()
         cached_count = self._update_count
-        self._reset_for_forward()
-        self._update_now(*args, **kwargs)
-        should_sync = self.dist_sync_on_step
-        prev_sync = self.sync_on_compute
-        self.sync_on_compute = should_sync
+        # the batch-value dance syncs and resets a TEMP delta cache: the
+        # batch sync must vote "full" and its reset() must not invalidate
+        # the accumulated state's prefix
+        global_dc = self._delta_cache
+        self._delta_cache = _DeltaCache()
+        self._last_synced_state = None
+        batch_synced = batch_state = None
         try:
-            batch_val = self._compute_wrapper()
+            self._reset_for_forward()
+            self._update_now(*args, **kwargs)
+            should_sync = self.dist_sync_on_step
+            prev_sync = self.sync_on_compute
+            self.sync_on_compute = should_sync
+            try:
+                batch_val = self._compute_wrapper()
+            finally:
+                self.sync_on_compute = prev_sync
+            batch_synced = self._last_synced_state
+            batch_state = self._copy_state()
         finally:
-            self.sync_on_compute = prev_sync
+            self._delta_cache = global_dc
+            self._last_synced_state = None
         self._restore_state(cache)
         self._update_count = cached_count
         self._computed = None
         self._is_synced = False
+        if batch_synced is not None and self._forward_delta_advance and self.delta_sync:
+            self._forward_advance_delta(cache, batch_state, batch_synced)
         return batch_val
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -1533,6 +1796,195 @@ class Metric(ABC):
                             problem=f"dtype drift {old_dt} -> {new_dt}",
                         )
 
+    # ------------------------------------------------------------- delta sync
+    def _delta_state_names(self) -> List[str]:
+        """States eligible for incremental gather: append-only cat/list rows.
+
+        Buffer states (``__buf``/``__len``) are excluded — their capacity
+        doubling rewrites rows in place — as are reduced scalars, which stay
+        on their one-shot collectives.
+        """
+        buffered = set()
+        for bname in self._buffer_states:
+            buffered.update((bname + "__buf", bname + "__len"))
+        names = []
+        for name, value in self._state.items():
+            if name in buffered:
+                continue
+            fx = self._reduce_fns.get(name)
+            if isinstance(value, list) or fx == "cat" or (fx is None and not isinstance(value, (int, tuple))):
+                names.append(name)
+        return sorted(names)
+
+    def _build_delta_plan(self) -> Optional[Dict[str, tuple]]:
+        """Validate the cached prefixes against the CURRENT local state.
+
+        Returns ``{name: ("list", skip_entries, watermark) | ("tensor",
+        watermark)}`` when every eligible state still extends its watermark
+        (rows were only appended since the last sync), else ``None`` — which
+        makes this rank vote for a full gather.  Purely local; the collective
+        agreement happens in the pre-flight token exchange.
+        """
+        if not self.delta_sync:
+            return None
+        dc = self._delta_cache
+        if dc.round < 1:
+            return None
+        names = self._delta_state_names()
+        if not names or set(dc.watermarks) != set(names):
+            return None
+        plan: Dict[str, tuple] = {}
+        for name in names:
+            wm = int(dc.watermarks[name])
+            prefix = dc.prefixes.get(name)
+            if prefix is None and wm != 0:
+                return None
+            value = self._state.get(name)
+            if isinstance(value, list):
+                skip = cum = 0
+                while skip < len(value) and cum < wm:
+                    cum += _rows_of(value[skip])
+                    skip += 1
+                if cum != wm:
+                    return None  # watermark falls inside an entry: rows changed
+                if prefix is not None and skip < len(value):
+                    head = jnp.atleast_1d(jnp.asarray(value[skip]))
+                    if (
+                        tuple(np.shape(head)[1:]) != tuple(np.shape(prefix)[1:])
+                        or head.dtype != jnp.asarray(prefix).dtype
+                    ):
+                        return None
+                plan[name] = ("list", skip, wm)
+            else:
+                arr = jnp.atleast_1d(value)
+                if _rows_of(arr) < wm:
+                    return None
+                if prefix is not None and (
+                    tuple(np.shape(arr)[1:]) != tuple(np.shape(prefix)[1:])
+                    or arr.dtype != jnp.asarray(prefix).dtype
+                ):
+                    return None
+                plan[name] = ("tensor", wm)
+        return plan
+
+    def _splice_prefix(self, name: str, gathered: Array) -> Array:
+        """Prepend the cached gathered prefix to this round's gathered delta.
+
+        Row order becomes (round, rank) blocks rather than the full gather's
+        (rank, rows) — a permutation that is IDENTICAL on every rank and
+        consistent across all of a metric's cat states (they append in
+        lockstep), so any order-insensitive compute is unaffected.
+        """
+        prefix = self._delta_cache.prefixes.get(name)
+        gathered = jnp.atleast_1d(gathered)
+        if prefix is None:
+            return gathered
+        if _rows_of(gathered) == 0:
+            return prefix
+        return jnp.concatenate([prefix, gathered], axis=0)
+
+    def _advance_delta_cache(
+        self, new_state: Dict[str, Any], delta_used: bool, report: Dict[str, Any]
+    ) -> None:
+        """After a successful sync, install the gathered result as the next
+        prefix and stamp the report with the delta telemetry."""
+        dc = self._delta_cache
+        saved = 0
+        if delta_used:
+            saved = sum(
+                int(getattr(np.asarray(p), "nbytes", 0))
+                for p in dc.prefixes.values()
+                if p is not None
+            )
+        report["delta"] = bool(delta_used)
+        report["bytes_saved"] = saved
+        # a full gather restarts the induction at round 1; a delta sync
+        # extends it — ranks that agree on the round hold identical prefixes
+        dc.round = dc.round + 1 if delta_used else 1
+        report["delta_round"] = dc.round
+        local = self._cache or {}
+        prefixes: Dict[str, Any] = {}
+        watermarks: Dict[str, int] = {}
+        for name in self._delta_state_names():
+            gv = new_state.get(name, self._state.get(name))
+            if isinstance(gv, list):
+                if not gv:
+                    prefixes[name] = None
+                    watermarks[name] = 0
+                    continue
+                gv = dim_zero_cat(gv)
+            prefixes[name] = jnp.atleast_1d(gv)
+            lv = local.get(name)
+            if isinstance(lv, list):
+                watermarks[name] = sum(_rows_of(x) for x in lv)
+            else:
+                watermarks[name] = _rows_of(lv) if lv is not None else 0
+        dc.prefixes.clear()
+        dc.prefixes.update(prefixes)
+        dc.watermarks.clear()
+        dc.watermarks.update(watermarks)
+
+    def _forward_advance_delta(
+        self,
+        cache: Dict[str, Any],
+        batch_state: Dict[str, Any],
+        batch_synced: Dict[str, Any],
+    ) -> None:
+        """Advance the delta cache for free off a ``dist_sync_on_step`` batch
+        gather: the batch rows every rank just exchanged ARE the global delta,
+        so the accumulated state's prefix can absorb them without another
+        collective — the epoch-end ``compute()`` then ships almost nothing.
+
+        Opt-in per class via ``_forward_delta_advance`` because it assumes
+        batch appends are state-independent (re-running ``update`` on a reset
+        state appends the same rows it appends on the accumulated state).
+        Any inconsistency clears the cache, which just means one full gather.
+        """
+        dc = self._delta_cache
+        try:
+            names = self._delta_state_names()
+            advanced_prefixes: Dict[str, Any] = {}
+            advanced_wms: Dict[str, int] = {}
+            for name in names:
+                total = cache.get(name)
+                batch = batch_state.get(name)
+                total_rows = (
+                    sum(_rows_of(x) for x in total) if isinstance(total, list) else _rows_of(total)
+                )
+                batch_rows = (
+                    sum(_rows_of(x) for x in batch) if isinstance(batch, list) else _rows_of(batch)
+                )
+                expected_prev = total_rows - batch_rows
+                if dc.round >= 1:
+                    if dc.watermarks.get(name) != expected_prev:
+                        dc.clear()
+                        return
+                elif expected_prev != 0 or dc.watermarks:
+                    # no verified prefix and pre-forward rows were never
+                    # globally gathered: cannot bootstrap from this batch
+                    dc.clear()
+                    return
+                gathered = batch_synced.get(name)
+                if isinstance(gathered, list):
+                    if gathered:
+                        gathered = dim_zero_cat(gathered)
+                    else:
+                        gathered = None  # all ranks empty this step
+                if gathered is None:
+                    advanced_prefixes[name] = dc.prefixes.get(name)
+                else:
+                    advanced_prefixes[name] = self._splice_prefix(name, jnp.atleast_1d(gathered))
+                advanced_wms[name] = total_rows
+            if not names:
+                return
+            dc.prefixes.clear()
+            dc.prefixes.update(advanced_prefixes)
+            dc.watermarks.clear()
+            dc.watermarks.update(advanced_wms)
+            dc.round = max(dc.round, 0) + 1
+        except Exception:
+            dc.clear()
+
     def _finish_sync_report(
         self, report: Dict[str, Any], backend: Backend, start: float
     ) -> None:
@@ -1579,6 +2031,8 @@ class Metric(ABC):
             raise MetricsTPUUserError("The Metric has already been synced.")
         self._flush_pending()
         self._flush_host_buffers()
+        self._last_synced_state = None
+        saved_options: Any = _UNSET
         if backend is None:
             backend = self.sync_backend
         if backend is None:
@@ -1588,53 +2042,84 @@ class Metric(ABC):
             or self.sync_max_retries is not None
             or self.sync_backoff is not None
         ):
-            # per-metric knobs take precedence over the injected backend's own
+            # per-metric knobs take precedence for THIS call only: the
+            # injected backend may be shared across metrics, and one metric's
+            # timeout/retry policy must not leak into the others'
+            saved_options = backend.options
             backend.options = self._sync_options()
-        if distributed_available is None:
-            distributed_available = backend.is_distributed()
-        self._cache = self._copy_state()
-        self._cached_count = self._update_count
-        if not should_sync or not distributed_available:
-            self._is_synced = True
-            return
-        report: Dict[str, Any] = {
-            "backend": type(backend).__name__,
-            "world_size": int(backend.world_size()) if backend.eager else None,
-            "fallback": None,
-            "error": None,
-        }
-        start = time.perf_counter()
         try:
-            if backend.eager:
-                if self.validate_sync:
-                    self._validate_state_integrity(self._state, "pre-sync")
-                info = backend.preflight_check(self._schema_entries(), self._update_count)
-                if info:
-                    report.update(info)
-            dist_sync_fn = dist_sync_fn or self.dist_sync_fn
-            if dist_sync_fn is not None:
-                new_state = dist_sync_fn(self._copy_state(), dict(self._reduce_fns), backend)
-            else:
-                new_state = self._sync_state_pure(self._state, backend)
-            if backend.eager and self.validate_sync:
-                self._validate_state_integrity(new_state, "post-sync", reference=self._cache)
-            self._state.update(new_state)
-            self._is_synced = True
-        except SyncError as err:
-            report["error"] = f"{type(err).__name__}: {err}"
-            if self.on_sync_error == "raise":
-                self._finish_sync_report(report, backend, start)
+            if distributed_available is None:
+                distributed_available = backend.is_distributed()
+            self._cache = self._copy_state()
+            self._cached_count = self._update_count
+            if not should_sync or not distributed_available:
+                self._is_synced = True
+                return
+            report: Dict[str, Any] = {
+                "backend": type(backend).__name__,
+                "world_size": int(backend.world_size()) if backend.eager else None,
+                "fallback": None,
+                "error": None,
+            }
+            start = time.perf_counter()
+            delta_plan = None
+            delta_ok = False
+            try:
+                backend_delta = backend.eager and getattr(backend, "supports_delta", False)
+                if backend.eager:
+                    if self.validate_sync:
+                        self._validate_state_integrity(self._state, "pre-sync")
+                    preflight_kwargs: Dict[str, Any] = {}
+                    if backend_delta and dist_sync_fn is None and self.dist_sync_fn is None:
+                        delta_plan = self._build_delta_plan()
+                        preflight_kwargs["delta_token"] = (
+                            self._delta_cache.token(list(delta_plan)) if delta_plan else None
+                        )
+                    info = backend.preflight_check(
+                        self._schema_entries(), self._update_count, **preflight_kwargs
+                    )
+                    if info:
+                        report.update(info)
+                    # delta only when EVERY rank voted a matching token
+                    delta_ok = bool(delta_plan) and bool((info or {}).get("delta_ok"))
+                dist_sync_fn = dist_sync_fn or self.dist_sync_fn
+                if dist_sync_fn is not None:
+                    new_state = dist_sync_fn(self._copy_state(), dict(self._reduce_fns), backend)
+                else:
+                    new_state = self._sync_state_pure(
+                        self._state, backend, delta_plan if delta_ok else None
+                    )
+                if backend.eager and self.validate_sync:
+                    self._validate_state_integrity(new_state, "post-sync", reference=self._cache)
+                self._state.update(new_state)
+                self._is_synced = True
+                self._last_synced_state = new_state
+                if backend_delta and dist_sync_fn is None and self.delta_sync:
+                    self._advance_delta_cache(new_state, delta_ok, report)
+            except SyncError as err:
+                # whatever this rank holds now, the fleet no longer provably
+                # shares one prefix — re-verify from a full gather next time
+                self._delta_cache.clear()
+                report["error"] = f"{type(err).__name__}: {err}"
+                if self.on_sync_error == "raise":
+                    self._finish_sync_report(report, backend, start)
+                    raise
+                report["fallback"] = "local"
+                if self.on_sync_error == "local":
+                    rank_zero_warn(
+                        f"Metric {type(self).__name__} sync failed ({type(err).__name__}: {err}); "
+                        "falling back to local unsynced state on this rank.",
+                        UserWarning,
+                    )
+                self._restore_state(self._cache)
+                self._is_synced = True
+            except BaseException:
+                self._delta_cache.clear()
                 raise
-            report["fallback"] = "local"
-            if self.on_sync_error == "local":
-                rank_zero_warn(
-                    f"Metric {type(self).__name__} sync failed ({type(err).__name__}: {err}); "
-                    "falling back to local unsynced state on this rank.",
-                    UserWarning,
-                )
-            self._restore_state(self._cache)
-            self._is_synced = True
-        self._finish_sync_report(report, backend, start)
+            self._finish_sync_report(report, backend, start)
+        finally:
+            if saved_options is not _UNSET:
+                backend.options = saved_options
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore the pre-sync local state (reference ``metric.py:444-464``)."""
@@ -1732,6 +2217,8 @@ class Metric(ABC):
         self._computed = None
         self._cache = None
         self._is_synced = False
+        self._delta_cache.clear()  # gathered prefixes describe the cleared epoch
+        self._last_synced_state = None
         for name, default in self._defaults.items():
             # fresh buffer per reset — the default itself must never be donated
             if isinstance(default, list):
@@ -1763,6 +2250,7 @@ class Metric(ABC):
     def set_dtype(self, dst_type: Any) -> "Metric":
         """Cast floating states (reference ``metric.py:588-614``)."""
         self._flush_pending()
+        self._delta_cache.clear()  # cached prefixes keep the old dtype
         self._dtype = dst_type
 
         def cast(v: Array) -> Array:
@@ -1812,6 +2300,7 @@ class Metric(ABC):
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self._delta_cache.clear()  # loaded rows were never part of a gathered prefix
         for name, value in state_dict.items():
             if name not in self._defaults:
                 raise KeyError(f"unknown state {name!r}")
@@ -1839,6 +2328,7 @@ class Metric(ABC):
         return out
 
     def load_state_pytree(self, tree: Dict[str, Any]) -> None:
+        self._delta_cache.clear()  # loaded rows were never part of a gathered prefix
         self._update_count = int(tree.pop("_update_count", 0))
         for name, value in tree.items():
             if isinstance(self._defaults.get(name), list) and not isinstance(value, list):
@@ -1876,6 +2366,10 @@ class Metric(ABC):
         }
         d["_cache"] = None
         d["_computed"] = None
+        # device-array prefixes don't pickle; a restored metric re-verifies
+        # from one full gather
+        d["_delta_cache"] = None
+        d["_last_synced_state"] = None
         return d
 
     def __setstate__(self, d: Dict[str, Any]) -> None:
@@ -1892,6 +2386,10 @@ class Metric(ABC):
             k: (v if isinstance(v, (list, int)) else jnp.asarray(v)) for k, v in d["_defaults"].items()
         }
         d.setdefault("sync_report_history", deque(maxlen=16))
+        d.setdefault("delta_sync", True)
+        d.setdefault("_last_synced_state", None)
+        if d.get("_delta_cache") is None:
+            d["_delta_cache"] = _DeltaCache()
         self.__dict__.update(d)
         self._install_wrappers()
 

@@ -203,7 +203,7 @@ def spans_snapshot() -> Dict[CounterKey, List[float]]:
 # ---------------------------------------------------------------------------
 # sync-report registry (absorbs Metric.last_sync_report; always on)
 
-_SYNC_COUNTER_KEYS = ("bytes_gathered", "gather_calls", "retries", "attempts")
+_SYNC_COUNTER_KEYS = ("bytes_gathered", "gather_calls", "retries", "attempts", "bytes_saved")
 
 
 def record_sync_report(metric: str, report: Dict[str, Any]) -> None:
@@ -221,6 +221,10 @@ def record_sync_report(metric: str, report: Dict[str, Any]) -> None:
     counter_inc("sync.reports", metric=metric)
     if report.get("error"):
         counter_inc("sync.errors", metric=metric)
+    if "delta" in report:
+        # the delta/full split only exists for gathers that actually ran; the
+        # single source is the report so per-metric and process totals agree
+        counter_inc("sync.delta_syncs" if report["delta"] else "sync.full_syncs", metric=metric)
     for key in _SYNC_COUNTER_KEYS:
         val = report.get(key) or 0
         if val:

@@ -3,6 +3,7 @@
 from metrics_tpu.parallel.backend import (
     AxisBackend,
     Backend,
+    LoopbackBackend,
     MultihostBackend,
     NullBackend,
     SyncOptions,
@@ -21,6 +22,7 @@ __all__ = [
     "Backend",
     "ChaosBackend",
     "ChaosInjectedError",
+    "LoopbackBackend",
     "MultihostBackend",
     "NullBackend",
     "SyncOptions",

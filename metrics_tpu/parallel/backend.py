@@ -264,6 +264,18 @@ class Backend:
     #: mismatch there fails loudly at trace time anyway.
     eager: bool = True
 
+    #: eager backends whose preflight exchange can vote on the incremental
+    #: (delta) cat-state protocol: row counts are concrete on the host, so a
+    #: metric may gather only the rows appended since its last successful
+    #: sync and splice them onto a cached gathered prefix.  In-trace
+    #: backends compile fixed-shape collectives and cannot.
+    supports_delta: bool = False
+
+    #: eager backends that can coalesce a whole state sync into one packed
+    #: byte-blob exchange (:meth:`all_gather_bytes`) instead of two
+    #: collectives per state — the latency win on the KV-store DCN path.
+    supports_packed: bool = False
+
     #: label set by the caller (the metric's per-state sync loop) so timeout
     #: diagnostics and telemetry can name the state being gathered
     _label: Optional[str] = None
@@ -279,7 +291,10 @@ class Backend:
             self._label = prev
 
     def preflight_check(
-        self, entries: Sequence[Tuple[str, str]], update_count: int = 0
+        self,
+        entries: Sequence[Tuple[str, str]],
+        update_count: int = 0,
+        delta_token: Optional[Tuple[int, int, int]] = None,
     ) -> Optional[Dict[str, Any]]:
         """Schema-agreement check before any state gather.
 
@@ -288,8 +303,20 @@ class Backend:
         :class:`SyncDesyncError` naming the diverging rank and state;
         non-distributed / in-trace backends are no-ops.  Returns optional
         info (e.g. peer update counts) for telemetry.
+
+        ``delta_token`` is this rank's incremental-sync proposal
+        ``(round, digest_lo, digest_hi)`` or ``None`` to demand a full
+        gather.  Delta-capable backends additionally exchange the token and
+        report ``delta_ok`` in the returned info: the delta path may only be
+        taken when EVERY rank proposed the identical token — any rank whose
+        prefix cache was invalidated (reset, fault, desync) forces the whole
+        fleet back to a verified full gather.
         """
         return None
+
+    def all_gather_bytes(self, payload: bytes) -> list:
+        """Gather one opaque byte blob per rank (packed sync transport)."""
+        raise NotImplementedError
 
     def pop_telemetry(self) -> Optional[Dict[str, Any]]:
         """Return and reset collective-level telemetry, if the backend keeps any."""
@@ -402,6 +429,9 @@ class MultihostBackend(Backend):
     bytes, retries) accumulates until :meth:`pop_telemetry`.
     """
 
+    supports_delta = True
+    supports_packed = True
+
     def __init__(self, options: Optional[SyncOptions] = None):
         self.options = options if options is not None else SyncOptions.from_env()
         self._telemetry: Dict[str, Any] = {}
@@ -512,24 +542,40 @@ class MultihostBackend(Backend):
         return np.stack(parts)
 
     def preflight_check(
-        self, entries: Sequence[Tuple[str, str]], update_count: int = 0
+        self,
+        entries: Sequence[Tuple[str, str]],
+        update_count: int = 0,
+        delta_token: Optional[Tuple[int, int, int]] = None,
     ) -> Optional[Dict[str, Any]]:
         """Exchange tiny per-state metadata digests BEFORE any state gather.
 
-        Two fixed-shape collectives (a scalar count, then ``(S, 16)`` digest
+        Two fixed-shape collectives (a small int row, then ``(S, 16)`` digest
         rows) — always gatherable no matter how far the peers diverged.  A
         registry-size or per-state signature mismatch raises
         :class:`SyncDesyncError` naming the diverging rank and state; the
         update counts ride along for telemetry (unequal counts are legal —
         uneven data shards — so they warn upstream rather than fail here).
+
+        The delta-sync vote rides in the same first collective: each rank
+        contributes ``(flag, round, digest_lo, digest_hi)`` from its
+        ``delta_token`` (flag 0 = demand full gather).  ``delta_ok`` in the
+        returned info is true only when every rank proposed the identical
+        non-null token — the collective agreement that makes the incremental
+        gather safe (all ranks splice onto prefixes built through the same
+        sequence of successful syncs).
         """
         if not self.is_distributed():
             return None
         me = self.rank()
+        flag, rnd, lo, hi = (1, *delta_token) if delta_token is not None else (0, 0, 0, 0)
         with self.annotate("preflight/schema"):
             meta = np.asarray(
-                self._gather(jnp.asarray([len(entries), int(update_count)], jnp.int32))
-            ).reshape(-1, 2)
+                self._gather(
+                    jnp.asarray(
+                        [len(entries), int(update_count), flag, rnd, lo, hi], jnp.int32
+                    )
+                )
+            ).reshape(-1, 6)
         counts = meta[:, 0]
         if not (counts == counts[me]).all():
             bad = int(np.nonzero(counts != counts[me])[0][0])
@@ -553,7 +599,12 @@ class MultihostBackend(Backend):
                     rank=rank,
                     state=name,
                 )
-        return {"peer_update_counts": [int(c) for c in meta[:, 1]]}
+        votes = meta[:, 2:6]
+        delta_ok = bool((votes[:, 0] == 1).all() and (votes == votes[0]).all())
+        return {
+            "peer_update_counts": [int(c) for c in meta[:, 1]],
+            "delta_ok": delta_ok,
+        }
 
     def psum(self, x):
         return jnp.sum(self._gather(x), axis=0)
@@ -584,6 +635,92 @@ class MultihostBackend(Backend):
         pad = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         gathered = self._gather(jnp.pad(x, pad))  # (P, max, ...)
         return jnp.concatenate([gathered[p, : sizes[p]] for p in range(len(sizes))], axis=0)
+
+    def all_gather_bytes(self, payload: bytes) -> list:
+        """One logical gather of an opaque byte blob per rank: sizes →
+        pad-to-max → gather → trim.
+
+        The packed sync path serializes a metric's ENTIRE state contribution
+        into one blob and rides on this, collapsing the whole sync into two
+        wire exchanges — on the KV-store CPU fallback that is two
+        coordination-service round trips instead of two per state.
+        """
+        buf = np.frombuffer(payload, np.uint8)
+        sizes = [int(s) for s in np.asarray(self._gather(jnp.asarray(buf.shape[0], jnp.int32)))]
+        max_size = max(sizes) if sizes else 0
+        padded = np.zeros(max_size, np.uint8)
+        padded[: buf.shape[0]] = buf
+        gathered = np.asarray(self._gather(padded)).reshape(len(sizes), max_size)
+        return [gathered[p, : sizes[p]].tobytes() for p in range(len(sizes))]
+
+
+class LoopbackBackend(Backend):
+    """Single-process stand-in for :class:`MultihostBackend` with real
+    telemetry.
+
+    A world of one: every gather is an identity, but each flows through the
+    same accounting (``gather_calls`` / ``bytes_gathered`` / packed payloads
+    / delta votes) as the DCN backend — so single-process tests and
+    benchmarks can measure the *shape* of sync traffic (e.g. that a K-step
+    streaming loop gathers O(K), not O(K²), bytes) without spawning
+    processes.  ``preflight_check`` approves any non-null delta token: with
+    one rank the collective agreement is trivially satisfied.
+    """
+
+    supports_delta = True
+    supports_packed = True
+
+    def __init__(self, options: Optional[SyncOptions] = None):
+        self.options = options if options is not None else SyncOptions.from_env()
+        self._telemetry: Dict[str, Any] = {}
+
+    def pop_telemetry(self) -> Optional[Dict[str, Any]]:
+        out, self._telemetry = self._telemetry, {}
+        return out
+
+    def is_distributed(self) -> bool:
+        return True
+
+    def world_size(self) -> int:
+        return 1
+
+    def rank(self) -> int:
+        return 0
+
+    def _count(self, nbytes: int) -> None:
+        self._telemetry["gather_calls"] = self._telemetry.get("gather_calls", 0) + 1
+        self._telemetry["bytes_gathered"] = self._telemetry.get("bytes_gathered", 0) + int(nbytes)
+
+    def preflight_check(
+        self,
+        entries: Sequence[Tuple[str, str]],
+        update_count: int = 0,
+        delta_token: Optional[Tuple[int, int, int]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        return {"peer_update_counts": [int(update_count)], "delta_ok": delta_token is not None}
+
+    def psum(self, x):
+        x = jnp.asarray(x)
+        self._count(x.nbytes)
+        return x
+
+    pmean = psum
+    pmax = psum
+    pmin = psum
+
+    def all_gather_cat(self, x):
+        x = jnp.atleast_1d(jnp.asarray(x))
+        self._count(x.nbytes)
+        return x
+
+    def all_gather_stack(self, x):
+        x = jnp.asarray(x)
+        self._count(x.nbytes)
+        return x[None]
+
+    def all_gather_bytes(self, payload: bytes) -> list:
+        self._count(len(payload))
+        return [payload]
 
 
 def get_backend(

@@ -102,8 +102,13 @@ class ChaosBackend(Backend):
         delay_secs: float = 0.05,
         drop_secs: float = 60.0,
         options: Optional[SyncOptions] = None,
+        packed: Optional[bool] = None,
     ):
         self.inner = inner
+        # packed sync collapses per-state collectives into one blob gather,
+        # which would renumber every existing fault schedule — so the chaos
+        # layer keeps the per-state op sequence unless a test opts in
+        self._packed = bool(packed) if packed is not None else False
         self.schedule = dict(schedule or {})
         for fault in self.schedule.values():
             kind = fault[0] if isinstance(fault, tuple) else fault
@@ -180,6 +185,16 @@ class ChaosBackend(Backend):
         return guarded_collective(faulted, self.options, label=label, telemetry=self._telemetry)
 
     # ---------------------------------------------------------------- protocol
+    @property
+    def supports_packed(self) -> bool:  # type: ignore[override]
+        return self._packed
+
+    @property
+    def supports_delta(self) -> bool:  # type: ignore[override]
+        # per-state delta slicing changes payload sizes but not the number or
+        # order of collectives, so delegating keeps fault schedules stable
+        return getattr(self.inner, "supports_delta", False)
+
     def is_distributed(self) -> bool:
         return self.inner.is_distributed() or (self._world or 1) > 1
 
@@ -200,8 +215,14 @@ class ChaosBackend(Backend):
         return out
 
     def preflight_check(
-        self, entries: Sequence[Tuple[str, str]], update_count: int = 0
+        self,
+        entries: Sequence[Tuple[str, str]],
+        update_count: int = 0,
+        delta_token: Optional[Tuple[int, int, int]] = None,
     ) -> Optional[Dict[str, Any]]:
+        inner_kwargs: Dict[str, Any] = {}
+        if getattr(self.inner, "supports_delta", False):
+            inner_kwargs["delta_token"] = delta_token
         idx, kind, arg = self._next_fault()
         if kind == "desync":
             state_idx = int(arg) if arg is not None else 0
@@ -211,7 +232,7 @@ class ChaosBackend(Backend):
                 entries = list(entries)
                 name, sig = entries[min(state_idx, len(entries) - 1)]
                 entries[min(state_idx, len(entries) - 1)] = (name, sig + "|chaos-desync")
-                return self.inner.preflight_check(entries, update_count)
+                return self.inner.preflight_check(entries, update_count, **inner_kwargs)
             # single-process: simulate the exchange — peer (world-1) diverges
             world = max(self.world_size(), 2)
             rows = schema_digest_rows(entries)
@@ -243,9 +264,13 @@ class ChaosBackend(Backend):
         if kind is not None:
             # non-desync faults apply to the underlying exchange collectives
             return self._guarded(
-                "preflight", lambda: self.inner.preflight_check(entries, update_count), idx, kind, arg
+                "preflight",
+                lambda: self.inner.preflight_check(entries, update_count, **inner_kwargs),
+                idx,
+                kind,
+                arg,
             )
-        return self.inner.preflight_check(entries, update_count)
+        return self.inner.preflight_check(entries, update_count, **inner_kwargs)
 
     # ------------------------------------------------------------- collectives
     def psum(self, x):
@@ -265,3 +290,9 @@ class ChaosBackend(Backend):
 
     def all_gather_stack(self, x):
         return self._run("all_gather_stack", lambda: self.inner.all_gather_stack(x))
+
+    def all_gather_bytes(self, payload: bytes) -> list:
+        # NaN-poisoning is a float-array transform, so a scheduled "corrupt"
+        # on this op is a no-op; corruption tests should stay on the
+        # per-state path (packed=False, the default)
+        return self._run("all_gather_bytes", lambda: self.inner.all_gather_bytes(payload))

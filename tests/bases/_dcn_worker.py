@@ -18,6 +18,11 @@ simulation):
 * ``stall`` — rank 1 never joins the sync and exits late; rank 0 must get a
   :class:`SyncTimeoutError` within its ``sync_timeout`` budget instead of
   blocking forever on the dead peer.
+* ``delta`` — a multi-round uneven-shard sync loop: round 1 must be a full
+  gather, later rounds incremental (watermark + cached prefix), the value
+  must match the full union every round, a one-rank cache invalidation must
+  force the WHOLE fleet back to a full gather via the pre-flight vote, and
+  wire bytes must stay O(rows appended), not O(rows accumulated).
 """
 
 import os
@@ -99,6 +104,57 @@ def _scenario_stall(rank: int, nproc: int) -> None:
     raise AssertionError("sync with a dead peer returned instead of timing out")
 
 
+def _scenario_delta(rank: int, nproc: int) -> None:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tests.bases.dummies import DummyListMetric
+
+    def round_rows(r: int, step: int) -> np.ndarray:
+        # uneven shards: rank r appends r+1 rows per round
+        return np.arange(r + 1, dtype=np.float32) + 100.0 * r + 10.0 * step
+
+    def union(upto_step: int) -> np.ndarray:
+        return np.concatenate(
+            [round_rows(r, s) for s in range(upto_step + 1) for r in range(nproc)]
+        )
+
+    m = DummyListMetric()  # autodetected MultihostBackend
+    reports = []
+    rounds = 4
+    for step in range(rounds):
+        m.update(jnp.asarray(round_rows(rank, step)))
+        val = np.asarray(m.compute())
+        m._computed = None
+        reports.append(dict(m.last_sync_report))
+        # delta splices in (round, rank) blocks — a rank-consistent
+        # permutation of the full gather's (rank, rows) order
+        np.testing.assert_allclose(np.sort(val), np.sort(union(step)))
+    assert reports[0]["delta"] is False and reports[0]["delta_round"] == 1, reports[0]
+    for rep in reports[1:]:
+        assert rep["delta"] is True and rep["bytes_saved"] > 0, rep
+    # O(appended), not O(accumulated): a later delta round must not ship
+    # more than an early one (both gather one round's rows)
+    assert reports[-1]["bytes_gathered"] <= reports[1]["bytes_gathered"] + 64, reports
+
+    # one rank losing its cache (restart, reset, ...) must push BOTH ranks
+    # back to a full gather through the pre-flight vote — silently delta-ing
+    # against divergent prefixes would corrupt every rank
+    if rank == 1:
+        m._delta_cache.clear()
+    m.update(jnp.asarray(round_rows(rank, rounds)))
+    val = np.asarray(m.compute())
+    m._computed = None
+    assert m.last_sync_report["delta"] is False, m.last_sync_report
+    np.testing.assert_allclose(np.sort(val), np.sort(union(rounds)))
+    # and the fallback re-arms the cache: the next round is delta again
+    m.update(jnp.asarray(round_rows(rank, rounds + 1)))
+    val = np.asarray(m.compute())
+    assert m.last_sync_report["delta"] is True, m.last_sync_report
+    np.testing.assert_allclose(np.sort(val), np.sort(union(rounds + 1)))
+    print(f"DCN_DELTA_OK rank={rank}", flush=True)
+
+
 def main() -> None:
     rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -114,6 +170,9 @@ def main() -> None:
         return
     if scenario == "stall":
         _scenario_stall(rank, nproc)
+        return
+    if scenario == "delta":
+        _scenario_delta(rank, nproc)
         return
     import numpy as np
     import jax.numpy as jnp

@@ -165,6 +165,17 @@ def test_multihost_stalled_peer_times_out():
     assert "DCN_STALL_OK rank=1 role=stalled" in results[1][1]
 
 
+def test_multihost_delta_sync_two_process():
+    """Real 2-process incremental sync: round 1 full-gathers, later rounds ship
+    only newly appended rows against the cached gathered prefix, values match
+    the full union every round, wire bytes stay O(appended), and one rank
+    dropping its cache forces the whole fleet back to a full gather through
+    the pre-flight vote before delta re-arms."""
+    for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="delta", timeout=120)):
+        assert code == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_DELTA_OK rank={r}" in out
+
+
 def test_multihost_uneven_gather_unit():
     """Unit test of the pad→gather→trim scheme against a faked stacked gather
     honoring the real ``process_allgather`` contract ``(P,) + x.shape``

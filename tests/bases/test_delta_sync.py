@@ -1,0 +1,463 @@
+"""Incremental delta-sync suite: delta gathers must be byte-cheap and
+value-identical to full re-gathers, and every trust-breaking event (fault,
+desync, reset, merge, pickle) must fall back to a full gather.
+
+Single-process coverage runs on :class:`LoopbackBackend` (world of one with
+real gather accounting) and simulated :class:`ChaosBackend` worlds; the real
+2-process protocol — including the pre-flight vote forcing a whole-fleet
+fallback — lives in ``test_ddp.py::test_multihost_delta_sync_two_process``.
+"""
+
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu.metric import Metric, _pack_state_blob, _unpack_state_blob
+from metrics_tpu.parallel import (
+    ChaosBackend,
+    ChaosInjectedError,
+    LoopbackBackend,
+    NullBackend,
+    SyncOptions,
+)
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.utils.exceptions import SyncDesyncError, SyncTimeoutError
+
+from tests.bases.dummies import DummyListMetric, DummyMetricSum
+
+
+class _TensorCatMetric(Metric):
+    """Cat state held as ONE growing tensor rather than a list of chunks."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("rows", jnp.zeros((0, 3), jnp.float32), dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.rows = jnp.concatenate([self.rows, jnp.atleast_2d(jnp.asarray(x, jnp.float32))])
+
+    def compute(self):
+        return self.rows
+
+
+class _MixedMetric(Metric):
+    """Append-only cat rows alongside a scalar sum reduction."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("rows", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        self.rows.append(x)
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        rows = self.rows
+        if isinstance(rows, list):
+            rows = jnp.concatenate([jnp.atleast_1d(r) for r in rows])
+        return rows, self.total
+
+
+def _rounds(m, steps, make_update):
+    """Drive ``steps`` update+compute rounds; return (values, reports)."""
+    vals, reports = [], []
+    for step in range(steps):
+        m.update(make_update(step))
+        vals.append(m.compute())
+        m._computed = None
+        reports.append(dict(m.last_sync_report))
+    return vals, reports
+
+
+# -------------------------------------------------------------- equivalence
+class TestDeltaEquivalence:
+    def test_list_state_matches_full(self):
+        rows = lambda step: jnp.arange(4.0) + 10.0 * step
+        delta_vals, delta_reps = _rounds(
+            DummyListMetric(sync_backend=LoopbackBackend()), 4, rows
+        )
+        full_vals, full_reps = _rounds(
+            DummyListMetric(sync_backend=LoopbackBackend(), delta_sync=False), 4, rows
+        )
+        for dv, fv in zip(delta_vals, full_vals):
+            np.testing.assert_allclose(np.asarray(dv), np.asarray(fv))
+        assert delta_reps[0]["delta"] is False and delta_reps[0]["delta_round"] == 1
+        for rep in delta_reps[1:]:
+            assert rep["delta"] is True and rep["bytes_saved"] > 0
+        # the kill switch removes the metric from the delta protocol entirely
+        assert all("delta" not in rep for rep in full_reps)
+
+    def test_tensor_cat_state_matches_full(self):
+        rows = lambda step: jnp.arange(6.0).reshape(2, 3) + step
+        delta_vals, delta_reps = _rounds(
+            _TensorCatMetric(sync_backend=LoopbackBackend()), 4, rows
+        )
+        full_vals, _ = _rounds(
+            _TensorCatMetric(sync_backend=LoopbackBackend(), delta_sync=False), 4, rows
+        )
+        for dv, fv in zip(delta_vals, full_vals):
+            np.testing.assert_allclose(np.asarray(dv), np.asarray(fv))
+        assert [rep["delta"] for rep in delta_reps] == [False, True, True, True]
+
+    def test_scalar_states_stay_on_full_collectives(self):
+        vals, reps = _rounds(DummyMetricSum(sync_backend=LoopbackBackend()), 3, float)
+        assert [float(v) for v in vals] == [0.0, 1.0, 3.0]
+        # no cat-like state: nothing to watermark, every sync is "full"
+        assert all(rep["delta"] is False for rep in reps)
+        assert all(rep["bytes_saved"] == 0 for rep in reps)
+
+    def test_mixed_states_delta_rows_and_reduced_scalar(self):
+        rows = lambda step: jnp.arange(3.0) + step
+        delta_vals, delta_reps = _rounds(_MixedMetric(sync_backend=LoopbackBackend()), 3, rows)
+        full_vals, _ = _rounds(
+            _MixedMetric(sync_backend=LoopbackBackend(), delta_sync=False), 3, rows
+        )
+        for (dr, dt), (fr, ft) in zip(delta_vals, full_vals):
+            np.testing.assert_allclose(np.asarray(dr), np.asarray(fr))
+            np.testing.assert_allclose(float(dt), float(ft))
+        assert [rep["delta"] for rep in delta_reps] == [False, True, True]
+
+    def test_packed_and_per_state_transports_agree(self):
+        rows = lambda step: jnp.arange(4.0) + step
+        packed_vals, packed_reps = _rounds(
+            DummyListMetric(sync_backend=LoopbackBackend()), 3, rows
+        )
+        # a faultless ChaosBackend opts out of the packed blob: same states
+        # flow through one all_gather_cat per state instead
+        per_state = ChaosBackend(LoopbackBackend(), schedule={})
+        assert per_state.supports_packed is False and per_state.supports_delta is True
+        state_vals, state_reps = _rounds(DummyListMetric(sync_backend=per_state), 3, rows)
+        for pv, sv in zip(packed_vals, state_vals):
+            np.testing.assert_allclose(np.asarray(pv), np.asarray(sv))
+        assert [r["delta"] for r in packed_reps] == [r["delta"] for r in state_reps]
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_DELTA_SYNC", "0")
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        assert m.delta_sync is False
+        vals, reps = _rounds(m, 2, lambda step: jnp.arange(3.0) + step)
+        np.testing.assert_allclose(np.asarray(vals[-1]), np.concatenate([np.arange(3.0), np.arange(3.0) + 1]))
+        assert all("delta" not in rep for rep in reps)
+
+
+# ------------------------------------------------------- wire-byte scaling
+class TestWireBytes:
+    def test_bytes_scale_with_appended_rows_not_history(self):
+        """The tentpole regression guard: K streaming syncs must ship O(K)
+        total bytes with delta on, vs the full re-gather's O(K²)."""
+        K = 10
+        rows = lambda step: jnp.arange(8.0) + step
+
+        def run(delta_sync):
+            m = DummyListMetric(sync_backend=LoopbackBackend(), delta_sync=delta_sync)
+            _, reps = _rounds(m, K, rows)
+            return [rep["bytes_gathered"] for rep in reps]
+
+        delta_bytes = run(True)
+        full_bytes = run(False)
+        # full mode re-ships the whole history: the last round costs ~K× the first
+        assert full_bytes[-1] >= 5 * full_bytes[0]
+        # delta mode ships one round's rows regardless of history length
+        assert delta_bytes[-1] <= delta_bytes[1] + 64
+        assert 2 * sum(delta_bytes) < sum(full_bytes)
+
+    def test_bytes_saved_grows_with_the_prefix(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        _, reps = _rounds(m, 4, lambda step: jnp.arange(4.0) + step)
+        saved = [rep["bytes_saved"] for rep in reps]
+        assert saved[0] == 0  # round 1 had no prefix to save
+        assert saved[1] > 0 and saved[2] > saved[1] and saved[3] > saved[2]
+
+
+# ----------------------------------------------------- fault → full fallback
+class TestFaultFallback:
+    def test_timeout_mid_delta_falls_back_then_reestablishes(self):
+        # ops per round: even=preflight, odd='x' gather → op 3 is round 2's
+        # gather, dropped mid-DELTA sync; the watchdog converts it to a
+        # SyncTimeoutError and the 'local' policy keeps compute alive
+        bk = ChaosBackend(
+            LoopbackBackend(),
+            schedule={3: ("drop", 5.0)},
+            options=SyncOptions(timeout=0.2, max_retries=0, backoff=0.01),
+        )
+        m = DummyListMetric(sync_backend=bk, on_sync_error="local")
+        rows = lambda step: jnp.arange(4.0) + 10.0 * step
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            vals, reps = _rounds(m, 4, rows)
+        assert reps[0]["delta"] is True or reps[0]["delta"] is False  # reported
+        assert reps[1]["error"].startswith("SyncTimeoutError")
+        assert reps[1]["fallback"] == "local"
+        # the failed round still computed (local rows == union in a world of 1;
+        # the unsynced list state comes back as per-update chunks, so flatten)
+        np.testing.assert_allclose(
+            np.asarray(vals[1]).ravel(), np.concatenate([np.arange(4.0), np.arange(4.0) + 10.0])
+        )
+        # trust was revoked: the next sync is a verified full gather...
+        assert reps[2]["delta"] is False and reps[2]["delta_round"] == 1
+        # ...which re-arms the cache for delta on the round after
+        assert reps[3]["delta"] is True
+        for v, step in zip(vals, range(4)):
+            np.testing.assert_allclose(
+                np.sort(np.asarray(v).ravel()),
+                np.sort(np.concatenate([np.arange(4.0) + 10.0 * s for s in range(step + 1)])),
+            )
+
+    def test_transient_error_clears_cache_even_when_raised(self):
+        bk = ChaosBackend(
+            LoopbackBackend(),
+            schedule={3: "error"},
+            options=SyncOptions(timeout=2.0, max_retries=0, backoff=0.01),
+        )
+        m = DummyListMetric(sync_backend=bk)
+        m.update(jnp.arange(3.0))
+        m.compute()
+        m._computed = None
+        assert m._delta_cache.round == 1
+        m.update(jnp.arange(3.0) + 10.0)
+        # ChaosInjectedError is not a SyncError: no policy applies, it
+        # propagates — but the cache must still be invalidated on the way out
+        with pytest.raises(ChaosInjectedError):
+            m.compute()
+        assert m._delta_cache.round == 0 and not m._delta_cache.watermarks
+        # recovery: full gather first, correct value
+        m._computed = None
+        val = np.asarray(m.compute())
+        m._computed = None
+        assert m.last_sync_report["delta"] is False
+        np.testing.assert_allclose(np.sort(val), np.sort(np.concatenate([np.arange(3.0), np.arange(3.0) + 10.0])))
+
+    def test_desync_clears_seeded_cache(self):
+        bk = ChaosBackend(
+            NullBackend(),
+            schedule={0: "desync"},
+            world_size=2,
+            options=SyncOptions(timeout=1.0, max_retries=0, backoff=0.01),
+        )
+        m = DummyListMetric(sync_backend=bk)
+        m.update(jnp.arange(3.0))
+        dc = m._delta_cache
+        dc.prefixes["x"] = jnp.arange(3.0)
+        dc.watermarks["x"] = 3
+        dc.round = 2
+        with pytest.raises(SyncDesyncError):
+            m.sync()
+        # a desynced fleet no longer provably shares one prefix
+        assert dc.round == 0 and not dc.prefixes and not dc.watermarks
+
+
+# ------------------------------------------------------ lifecycle invalidation
+class TestLifecycle:
+    def test_prefix_cache_survives_unsync(self):
+        bk = LoopbackBackend()
+        m = DummyListMetric(sync_backend=bk)
+        m.update(jnp.arange(4.0))
+        with m.sync_context():
+            pass
+        assert not m._is_synced
+        # unsync restores LOCAL rows but the gathered prefix stays trusted —
+        # that is what makes the next sync O(appended)
+        assert m._delta_cache.round == 1 and m._delta_cache.watermarks == {"x": 4}
+        m.update(jnp.arange(4.0) + 10.0)
+        with m.sync_context():
+            rep = dict(m.last_sync_report)
+        assert rep["delta"] is True and rep["bytes_saved"] > 0
+
+    def test_reset_forces_full_gather(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        _rounds(m, 2, lambda step: jnp.arange(3.0) + step)
+        assert m._delta_cache.round == 2
+        m.reset()
+        assert m._delta_cache.round == 0 and not m._delta_cache.prefixes
+        _, reps = _rounds(m, 2, lambda step: jnp.arange(3.0) + step)
+        assert [rep["delta"] for rep in reps] == [False, True]
+
+    def test_merge_state_multiway_and_cache_invalidation(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        m.update(jnp.arange(3.0))
+        m.compute()
+        m._computed = None
+        assert m._delta_cache.round == 1
+        others = []
+        for off in (10.0, 20.0):
+            o = DummyListMetric()
+            o.update(jnp.arange(3.0) + off)
+            others.append(o.state)
+        m.merge_state(others)
+        # merged-in rows were never part of the gathered prefix
+        assert m._delta_cache.round == 0
+        val = np.asarray(m.compute())
+        m._computed = None
+        assert m.last_sync_report["delta"] is False
+        np.testing.assert_allclose(
+            np.sort(val),
+            np.sort(np.concatenate([np.arange(3.0) + off for off in (0.0, 10.0, 20.0)])),
+        )
+
+    def test_pickle_drops_cache_keeps_flag(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        _rounds(m, 2, lambda step: jnp.arange(3.0) + step)
+        assert m._delta_cache.round == 2
+        m2 = pickle.loads(pickle.dumps(m))
+        assert m2.delta_sync is True
+        assert m2._delta_cache.round == 0 and not m2._delta_cache.prefixes
+        assert m2._last_synced_state is None
+        m2.sync_backend = LoopbackBackend()
+        m2.update(jnp.arange(3.0) + 50.0)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(m2.compute())),
+            np.sort(np.concatenate([np.arange(3.0), np.arange(3.0) + 1, np.arange(3.0) + 50.0])),
+        )
+        # the restored process must re-verify with a full gather
+        assert m2.last_sync_report["delta"] is False
+
+
+# ------------------------------------------------- shared backends/collections
+class TestSharing:
+    def test_injected_backend_options_restored_after_sync(self):
+        orig = SyncOptions(timeout=30.0, max_retries=2, backoff=0.5)
+        bk = LoopbackBackend(options=orig)
+        m = DummyListMetric(sync_timeout=1.0)  # per-metric knob swaps for the call
+        m.update(jnp.arange(3.0))
+        m.sync(backend=bk)
+        m.unsync()
+        assert bk.options is orig
+
+    def test_injected_backend_options_restored_after_failure(self):
+        orig = SyncOptions(timeout=30.0, max_retries=2, backoff=0.5)
+        bk = ChaosBackend(NullBackend(), schedule={0: "desync"}, world_size=2, options=orig)
+        m1 = DummyMetricSum(sync_timeout=0.5, on_sync_error="raise")
+        m1.update(1.0)
+        with pytest.raises(SyncDesyncError):
+            m1.sync(backend=bk)
+        # one metric's timeout policy must not leak into the shared backend,
+        # even when its sync raises
+        assert bk.options is orig
+        m2 = DummyMetricSum(sync_timeout=9.0, on_sync_error="raise")
+        m2.update(2.0)
+        with pytest.raises(SyncDesyncError):  # op 1 replays nothing; preflight only fired once
+            m2.sync(backend=ChaosBackend(NullBackend(), schedule={0: "desync"}, world_size=2, options=orig))
+        assert bk.options is orig
+
+    def test_collection_compute_group_shares_one_cache(self):
+        bk = LoopbackBackend()
+        col = MetricCollection(
+            {"a": DummyListMetric(sync_backend=bk), "b": DummyListMetric(sync_backend=bk)},
+            compute_groups=[["a", "b"]],
+        )
+        for step in range(3):
+            col.update(jnp.arange(4.0) + 10.0 * step)
+            col.compute()
+            for m in col.values():
+                m._computed = None
+        # shared states need ONE watermark: both members alias the leader's cache
+        assert col["a"]._delta_cache is col["b"]._delta_cache
+        reps = col.last_sync_report
+        assert reps["a"]["delta"] is True and reps["b"]["delta"] is True
+        agg = col.aggregate_sync_report()
+        assert agg["members_reporting"] == 2
+        assert agg["delta_syncs"] == 2 and agg["full_syncs"] == 0
+        assert agg["bytes_saved"] > 0
+
+
+# ------------------------------------------------------- forward fast advance
+class TestForwardAdvance:
+    def test_dist_sync_on_step_advances_cache_when_opted_in(self):
+        class _AdvListMetric(DummyListMetric):
+            _forward_delta_advance = True
+
+        m = _AdvListMetric(dist_sync_on_step=True, sync_backend=LoopbackBackend())
+        for step in range(3):
+            batch = jnp.arange(4.0) + 10.0 * step
+            out = m(batch)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(batch))
+        # each per-step batch gather WAS the global delta: the prefix absorbed
+        # it without any extra collective
+        assert m._delta_cache.round == 3
+        assert m._delta_cache.watermarks == {"x": 12}
+        val = np.asarray(m.compute())
+        # epoch-end compute ships only the (empty) un-gathered tail
+        assert m.last_sync_report["delta"] is True
+        assert m.last_sync_report["bytes_saved"] > 0
+        np.testing.assert_allclose(
+            val, np.concatenate([np.arange(4.0) + 10.0 * s for s in range(3)])
+        )
+
+    def test_dist_sync_on_step_leaves_cache_alone_by_default(self):
+        m = DummyListMetric(dist_sync_on_step=True, sync_backend=LoopbackBackend())
+        for step in range(3):
+            batch = jnp.arange(4.0) + 10.0 * step
+            out = m(batch)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(batch))
+        # no opt-in: the batch-value dance must not touch the global cache
+        assert m._delta_cache.round == 0 and not m._delta_cache.watermarks
+        val = np.asarray(m.compute())
+        assert m.last_sync_report["delta"] is False
+        np.testing.assert_allclose(
+            val, np.concatenate([np.arange(4.0) + 10.0 * s for s in range(3)])
+        )
+
+
+# ------------------------------------------------------------- observability
+class TestObservability:
+    def test_counters_roll_up_into_sync_summary(self):
+        before = obs.counters_snapshot()
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        _, reps = _rounds(m, 3, lambda step: jnp.arange(4.0) + step)
+        after = obs.counters_snapshot()
+        diff = {k: v - before.get(k, 0) for k, v in after.items() if v != before.get(k, 0)}
+        sync = obs.summarize_counters(diff).get("sync", {})
+        assert sync.get("full_syncs") == 1
+        assert sync.get("delta_syncs") == 2
+        assert sync.get("bytes_saved", 0) > 0
+        assert sync.get("bytes_gathered", 0) > 0
+        rep = reps[-1]
+        assert rep["delta"] is True and rep["delta_round"] == 3 and rep["bytes_saved"] > 0
+
+
+# ----------------------------------------------------------- packed transport
+class TestPackedBlob:
+    def test_state_blob_roundtrip_preserves_shape_dtype_order(self):
+        import ml_dtypes
+
+        payload = {
+            "c.x": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "r.scalar": np.float64(3.5),  # 0-d must stay 0-d
+            "r.zero": np.zeros((0, 4), np.int32),
+            "r.bf16": np.asarray([1.5, 2.5], dtype=ml_dtypes.bfloat16),
+            "b.fortran": np.asfortranarray(np.arange(12.0).reshape(3, 4)),
+        }
+        out = _unpack_state_blob(_pack_state_blob(payload))
+        assert set(out) == set(payload)
+        for key, val in payload.items():
+            arr = np.asarray(val)
+            assert out[key].shape == arr.shape
+            assert out[key].dtype == arr.dtype
+            np.testing.assert_array_equal(out[key], arr)
+
+    def test_loopback_gather_accounting(self):
+        bk = LoopbackBackend()
+        shards = bk.all_gather_bytes(b"\x01" * 100)
+        assert shards == [b"\x01" * 100]
+        tel = bk.pop_telemetry()
+        assert tel["gather_calls"] == 1 and tel["bytes_gathered"] == 100
+        assert bk.pop_telemetry() in (None, {})  # drained
+
+
+# ------------------------------------------------------------------ bench glue
+class TestBenchGlue:
+    def test_h2d_bandwidth_measures_transfer_not_dispatch(self):
+        import bench
+
+        bw = bench._measure_h2d_bandwidth(mb=4)
+        assert np.isfinite(bw) and bw > 0
