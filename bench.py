@@ -843,6 +843,69 @@ def _bench_map_segm_scale(n_img=500, canvas=(480, 640)):
     return n_img / (t_update + t_compute), prof
 
 
+def _bench_streaming(n_batches=512, batch=8192, window=8):
+    """Config 6: streaming subsystem — KLL quantile sketch + windowed mean.
+
+    Prices the O(1)-state pitch: one stream through jitted sketch updates
+    (fixed-shape state, so the trace count must not move inside the timed
+    window — ``timed_recompiles`` below is the proof), with a
+    ``WindowedMetric`` rotating its ring buffer every
+    ``n_batches // window`` updates.  The streaming.* counter deltas
+    (compactions, evictions, merge calls) ride the profile so the compact
+    line carries them as ``config6_streaming_*`` scalars.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import MeanMetric, StreamingQuantile, WindowedMetric
+    from metrics_tpu.obs import counters_snapshot
+
+    # generated on device: host->device transfer is not the workload
+    data = jax.random.normal(jax.random.PRNGKey(5), (n_batches, batch), jnp.float32)
+    float(data[0, 0])
+    sq = StreamingQuantile(q=(0.5, 0.99))
+    wm = WindowedMetric(MeanMetric(), window_size=window)
+    advance_every = max(1, n_batches // window)
+
+    def run():
+        sq.reset()
+        wm.reset()
+        for i in range(n_batches):
+            sq.update(data[i])
+            wm.update(data[i])
+            if (i + 1) % advance_every == 0:
+                wm.advance()
+        q = np.asarray(sq.compute())  # value fetch = completion barrier
+        m = float(jnp.asarray(wm.compute()))
+        return q, m
+
+    run()  # warm every trace (update, advance slot shapes, computes)
+    before = counters_snapshot()
+    t = _median_time(run, repeats=3)
+    delta = {
+        k: v - before.get(k, 0)
+        for k, v in counters_snapshot().items()
+        if v != before.get(k, 0)
+    }
+    streaming = {}
+    recompiles = 0
+    for (cname, _labels), v in delta.items():
+        if cname.startswith("streaming."):
+            field = cname[len("streaming."):]
+            streaming[field] = streaming.get(field, 0) + int(v)
+        elif cname == "jit_traces":
+            recompiles += int(v)
+    profile = {
+        "streaming_counters": streaming,
+        # three timed repeats after warmup: any nonzero here means the
+        # fixed-shape contract broke and updates are retracing per batch
+        "timed_recompiles": recompiles,
+        "window_size": window,
+        "advance_every": advance_every,
+    }
+    return (n_batches * batch) / t, profile
+
+
 def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -947,6 +1010,7 @@ def main() -> None:
         ("config5_map_ddp_images_per_sec", _bench_detection_ddp),
         ("config5_map_coco_scale_images_per_sec", _bench_map_coco_scale),
         ("config5_map_segm_scale_images_per_sec", _bench_map_segm_scale),
+        ("config6_streaming_samples_per_sec", _bench_streaming),
         ("device_mfu", _bench_mfu),
     ):
         obs_before = _obs_counters()
@@ -973,6 +1037,14 @@ def main() -> None:
             elif name.startswith("config4"):
                 extra[name] = round(result[0], 1)
                 extra["config4_breakdown"] = result[1]
+            elif name.startswith("config6_streaming"):
+                extra[name] = round(result[0], 1)
+                extra["config6_streaming_profile"] = result[1]
+                # lift the counters to scalars so the compact line (which
+                # drops nested dicts) still carries the streaming telemetry
+                for key, val in (result[1].get("streaming_counters") or {}).items():
+                    extra[f"config6_streaming_{key}"] = val
+                extra["config6_streaming_timed_recompiles"] = result[1]["timed_recompiles"]
             elif name == "device_mfu":
                 extra[name] = result
             else:

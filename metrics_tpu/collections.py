@@ -479,6 +479,29 @@ class MetricCollection:
                 # prefix at the wrong row
                 member._delta_cache = leader._delta_cache
 
+    def advance_windows(self) -> Dict[str, int]:
+        """Rotate every ``WindowedMetric`` member to its next bucket.
+
+        Compute-group members alias their leader's state arrays, so only
+        group LEADERS advance (advancing an aliased member twice would skip
+        buckets); the refreshed leader states are then re-shared.  Returns
+        ``{member_name: evicted_update_count}`` for the advanced windows.
+        """
+        from metrics_tpu.streaming.window import WindowedMetric
+
+        evicted: Dict[str, int] = {}
+        if self._groups_checked and self._compute_groups:
+            for group in self._compute_groups.values():
+                leader = self._modules[group[0]]
+                if isinstance(leader, WindowedMetric):
+                    evicted[group[0]] = leader.advance()
+            self._share_group_states()
+        else:
+            for name, m in self._modules.items():
+                if isinstance(m, WindowedMetric):
+                    evicted[name] = m.advance()
+        return evicted
+
     def compute(self) -> Dict[str, Any]:
         if _OBS_RT.enabled:
             # member metric.compute spans nest under this one, giving

@@ -295,6 +295,9 @@ class Metric(ABC):
         # capacity-bounded buffer states (SURVEY §7 delta 2(b)):
         # name -> {count, capacity, alloc_cap, trail, dtype}
         self._buffer_states: Dict[str, Dict[str, Any]] = {}
+        # fixed-shape mergeable sketch states (streaming/ subsystem):
+        # name -> {"merge": callable([tree, ...]) -> tree, "leaves": [leaf, ...]}
+        self._sketch_states: Dict[str, Dict[str, Any]] = {}
         self._buffer_rows_by_sig: Dict[Any, Dict[str, int]] = {}
         self._recording_rows: Optional[Dict[str, int]] = None
         self._state_swapped = False
@@ -595,6 +598,71 @@ class Metric(ABC):
             meta["trail"] = tuple(buf.shape[1:])
             meta["dtype"] = buf.dtype
 
+    # ------------------------------------------------------- sketch states
+    def add_sketch_state(
+        self,
+        name: str,
+        default: Dict[str, Any],
+        merge_fn: Callable,
+        persistent: bool = False,
+    ) -> None:
+        """Register a fixed-shape mergeable sketch state (streaming/ subsystem).
+
+        ``default`` is a flat dict of fixed-shape arrays (the sketch's state
+        pytree, e.g. :func:`metrics_tpu.streaming.kll_init`); ``merge_fn``
+        folds a *sequence* of such trees into one (e.g.
+        :func:`metrics_tpu.streaming.kll_merge`).  Each leaf becomes a normal
+        tensor state named ``<name>__sk_<leaf>`` whose ``dist_reduce_fx`` is
+        the string ``"sketch"`` — the sync path gathers every rank's leaves,
+        reassembles the per-rank trees, and reduces them through ``merge_fn``
+        (:meth:`Backend.all_gather_merge`); ``merge_state`` does the same
+        multi-way on the host.  Sketches are fixed-size, so they never
+        participate in delta-sync (nothing to slice) and ride the packed-blob
+        transport as plain arrays.
+
+        Leaves may legitimately hold ``±inf`` padding, so ``validate_sync``
+        integrity checks skip sketch leaves.
+        """
+        if not isinstance(default, dict) or not default:
+            raise ValueError("sketch state default must be a non-empty dict of arrays")
+        if not callable(merge_fn):
+            raise ValueError("sketch merge_fn must be callable")
+        if not name.isidentifier():
+            raise ValueError(f"state name must be a valid identifier, got {name!r}")
+        if name in self._sketch_states:
+            raise ValueError(f"sketch state {name!r} already registered")
+        leaves = sorted(default)
+        for leaf in leaves:
+            if not leaf.isidentifier():
+                raise ValueError(f"sketch leaf name must be a valid identifier, got {leaf!r}")
+            key = f"{name}__sk_{leaf}"
+            self.add_state(key, jnp.asarray(default[leaf]), dist_reduce_fx=None, persistent=persistent)
+            # "sketch" is not user-facing in add_state (it needs the merge_fn
+            # registration below); stamp it past the _ALLOWED_REDUCE gate
+            self._reduce_fns[key] = "sketch"
+        self._sketch_states[name] = {"merge": merge_fn, "leaves": leaves}
+
+    def _sketch_leaf_keys(self, name: str) -> List[str]:
+        return [f"{name}__sk_{leaf}" for leaf in self._sketch_states[name]["leaves"]]
+
+    def sketch_tree(self, name: str, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """The sketch's state pytree (leaf name -> array), read from ``state``
+        or the live metric state."""
+        meta = self._sketch_states[name]
+        if state is None:
+            if not self._state_swapped:
+                self._flush_pending()
+            state = self._state
+        return {leaf: state[f"{name}__sk_{leaf}"] for leaf in meta["leaves"]}
+
+    def _store_sketch_tree(self, name: str, tree: Dict[str, Any], state: Optional[Dict[str, Any]] = None) -> None:
+        """Write a sketch pytree back into ``state`` (or the live state)."""
+        target = self._state if state is None else state
+        for leaf in self._sketch_states[name]["leaves"]:
+            target[f"{name}__sk_{leaf}"] = tree[leaf]
+
+    def _sketch_leaf_key_set(self) -> set:
+        return {k for name in self._sketch_states for k in self._sketch_leaf_keys(name)}
 
     def _buffer_rows_signature(self, args: tuple, kwargs: dict) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
@@ -793,6 +861,15 @@ class Metric(ABC):
             self._state[lkey] = int(self._state[bkey].shape[0])
             self._refresh_buffer_meta(bname)
             skip_keys.update((bkey, lkey))
+        for sname, smeta in self._sketch_states.items():
+            keys = self._sketch_leaf_keys(sname)
+            if keys[0] not in self._state:
+                continue
+            trees = [{leaf: s[k] for leaf, k in zip(smeta["leaves"], keys)} for s in [self._state] + list(others)]
+            merged_tree = smeta["merge"](trees)
+            for leaf, k in zip(smeta["leaves"], keys):
+                self._state[k] = jnp.asarray(merged_tree[leaf])
+            skip_keys.update(keys)
         merged = {}
         for name, value in self._state.items():
             if name in skip_keys:
@@ -871,6 +948,16 @@ class Metric(ABC):
                         gathered = backend.all_gather_cat(vals)
                         out[bkey] = gathered
                         out[lkey] = int(gathered.shape[0])
+            for sname, smeta in self._sketch_states.items():
+                keys = self._sketch_leaf_keys(sname)
+                if keys[0] not in state:
+                    continue
+                tree = {leaf: state.pop(k) for leaf, k in zip(smeta["leaves"], keys)}
+                with backend.annotate(sname):
+                    merged_tree = backend.all_gather_merge(tree, smeta["merge"])
+                _obs.counter_inc("streaming.sketch_merge_calls", metric=type(self).__name__)
+                for leaf, k in zip(smeta["leaves"], keys):
+                    out[k] = merged_tree[leaf]
             for name, value in state.items():
                 fx = self._reduce_fns[name]
                 with backend.annotate(name):
@@ -915,6 +1002,16 @@ class Metric(ABC):
         buffer_names: List[str] = []
         cat_names: List[str] = []
         reduce_names: List[str] = []
+        sketch_names: List[str] = []
+        for sname in self._sketch_states:
+            keys = self._sketch_leaf_keys(sname)
+            if keys[0] not in state:
+                continue
+            # sketch leaves are fixed-size arrays: ship them whole in the
+            # blob (never delta-sliced — there is no appended suffix to cut)
+            for k in keys:
+                payload["s." + k] = np.asarray(state.pop(k))
+            sketch_names.append(sname)
         for bname in self._buffer_states:
             bkey, lkey = bname + "__buf", bname + "__len"
             if bkey not in state:
@@ -979,6 +1076,17 @@ class Metric(ABC):
                 out[name] = jnp.min(stacked, axis=0)
             else:
                 out[name] = fx(stacked)
+        for sname in sketch_names:
+            smeta = self._sketch_states[sname]
+            keys = self._sketch_leaf_keys(sname)
+            trees = [
+                {leaf: jnp.asarray(r["s." + k]) for leaf, k in zip(smeta["leaves"], keys)}
+                for r in per_rank
+            ]
+            merged_tree = smeta["merge"](trees) if len(trees) > 1 else trees[0]
+            _obs.counter_inc("streaming.sketch_merge_calls", metric=type(self).__name__)
+            for leaf, k in zip(smeta["leaves"], keys):
+                out[k] = jnp.asarray(merged_tree[leaf])
         return out
 
     # ---------------------------------------------------------------- update
@@ -1531,6 +1639,7 @@ class Metric(ABC):
         # always correct)
         no_fast_merge = any(
             (callable(fx) and not isinstance(fx, str))
+            or fx == "sketch"
             or (fx is None and not isinstance(self._state[name], list))
             for name, fx in self._reduce_fns.items()
         )
@@ -1764,8 +1873,10 @@ class Metric(ABC):
         """NaN/Inf + dtype-drift checks for ``validate_sync=True`` (eager only)."""
         import jax.core
 
+        sketch_keys = self._sketch_leaf_key_set()
         for name, value in state.items():
-            if name.endswith("__len"):
+            # sketch leaves legitimately hold ±inf padding sentinels
+            if name.endswith("__len") or name in sketch_keys:
                 continue
             leaves = value if isinstance(value, list) else [value]
             for leaf in leaves:
@@ -1802,7 +1913,9 @@ class Metric(ABC):
 
         Buffer states (``__buf``/``__len``) are excluded — their capacity
         doubling rewrites rows in place — as are reduced scalars, which stay
-        on their one-shot collectives.
+        on their one-shot collectives.  Sketch leaves (``fx == "sketch"``)
+        are excluded structurally: a sketch is fixed-size and compactions
+        rewrite it in place, so there is never an appended suffix to ship.
         """
         buffered = set()
         for bname in self._buffer_states:

@@ -185,6 +185,7 @@ def summarize_counters(
     recompiles = 0.0
     by_metric: Dict[str, float] = {}
     sync: Dict[str, float] = {}
+    streaming: Dict[str, float] = {}
     iou_hits = iou_misses = 0.0
     fallbacks = 0.0
     faults = 0.0
@@ -199,6 +200,9 @@ def summarize_counters(
         elif name.startswith("sync."):
             field = name[len("sync."):]
             sync[field] = sync.get(field, 0) + value
+        elif name.startswith("streaming."):
+            field = name[len("streaming."):]
+            streaming[field] = streaming.get(field, 0) + value
         elif name == "iou_cache.hits":
             iou_hits += value
         elif name == "iou_cache.misses":
@@ -217,6 +221,8 @@ def summarize_counters(
         out["sync"] = {
             k: (round(v, 6) if k == "backoff_secs" else int(v)) for k, v in sorted(sync.items())
         }
+    if streaming:
+        out["streaming"] = {k: int(v) for k, v in sorted(streaming.items())}
     if iou_hits or iou_misses:
         out["iou_cache"] = {
             "hits": int(iou_hits),

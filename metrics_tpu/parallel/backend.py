@@ -348,6 +348,28 @@ class Backend:
         """Gather with a new leading participant dim."""
         raise NotImplementedError
 
+    def all_gather_merge(self, tree: Dict[str, Array], merge_fn) -> Dict[str, Array]:
+        """Merge-on-gather for fixed-shape sketch states.
+
+        Gathers every leaf with a leading participant dim, reassembles the
+        per-rank state trees, and reduces them through ``merge_fn`` — so the
+        wire cost is one stacked gather per leaf and the reduction runs
+        identically on every rank (sketch merges are deterministic given the
+        gathered states, keeping ranks in agreement without a broadcast).
+
+        The participant count is derived from the *stacked leaf shape*, not
+        :meth:`world_size`: under an in-trace backend the world size may be a
+        traced value (``lax.psum(1, axis)``), while the gathered leading dim
+        is always static.
+        """
+        leaves = sorted(tree)
+        stacked = {k: self.all_gather_stack(jnp.asarray(tree[k])) for k in leaves}
+        nranks = int(stacked[leaves[0]].shape[0])
+        if nranks == 1:
+            return {k: stacked[k][0] for k in leaves}
+        ranks = [{k: stacked[k][p] for k in leaves} for p in range(nranks)]
+        return merge_fn(ranks)
+
 
 class NullBackend(Backend):
     def is_distributed(self) -> bool:

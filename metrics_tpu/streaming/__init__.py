@@ -1,0 +1,47 @@
+"""Streaming evaluation: fixed-shape mergeable sketches, windowed metrics,
+O(1)-state online quantiles.
+
+See ``docs/streaming.md`` for guarantees and when to prefer bounded sketch
+state over ``cat``/list states.
+"""
+
+from metrics_tpu.streaming.sketches import (
+    DEFAULT_CAPACITY,
+    DEFAULT_MAX_ITEMS,
+    bootstrap_resample_indices,
+    kll_cdf,
+    kll_init,
+    kll_merge,
+    kll_quantile,
+    kll_rank_error_bound,
+    kll_total_weight,
+    kll_update,
+    reservoir_init,
+    reservoir_merge,
+    reservoir_update,
+    reservoir_values,
+)
+from metrics_tpu.streaming.quantile import SketchMetric, StreamingHistogram, StreamingQuantile
+from metrics_tpu.streaming.window import TimeDecayedMetric, WindowedMetric
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "DEFAULT_MAX_ITEMS",
+    "SketchMetric",
+    "StreamingHistogram",
+    "StreamingQuantile",
+    "TimeDecayedMetric",
+    "WindowedMetric",
+    "bootstrap_resample_indices",
+    "kll_cdf",
+    "kll_init",
+    "kll_merge",
+    "kll_quantile",
+    "kll_rank_error_bound",
+    "kll_total_weight",
+    "kll_update",
+    "reservoir_init",
+    "reservoir_merge",
+    "reservoir_update",
+    "reservoir_values",
+]

@@ -1,0 +1,170 @@
+"""Streaming quantile / histogram metrics over the KLL sketch.
+
+Bounded-state replacements for ``cat``-state percentile evaluation: state is
+a fixed ``(levels, capacity)`` sketch regardless of stream length, updates
+are constant-shape (zero recompiles after warmup), and cross-rank sync rides
+the ``"sketch"`` reduce — every rank gathers peer sketches and folds them
+with :func:`~metrics_tpu.streaming.sketches.kll_merge`, so the synced
+estimate is as good as one sketch over the union of all shards.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.obs import core as _obs
+from metrics_tpu.streaming.sketches import (
+    DEFAULT_CAPACITY,
+    DEFAULT_MAX_ITEMS,
+    kll_cdf,
+    kll_init,
+    kll_merge,
+    kll_quantile,
+    kll_rank_error_bound,
+    kll_total_weight,
+    kll_update,
+)
+
+__all__ = ["SketchMetric", "StreamingQuantile", "StreamingHistogram"]
+
+
+class SketchMetric(Metric):
+    """Base for metrics whose primary state is one KLL sketch named
+    ``"sketch"``.
+
+    Registers the sketch state and surfaces the sketch's device-side
+    compaction counter into the host ``streaming.sketch_compactions`` obs
+    counter whenever host buffers are flushed (i.e. on any state read) —
+    best-effort: merged-in or synced compaction history counts once, and a
+    ``reset()`` re-arms the baseline.
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        seed: int = 0,
+        max_items: int = DEFAULT_MAX_ITEMS,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.capacity = int(capacity)
+        self._nc_seen = 0
+        self._nc_count_mark = -1
+        self.add_sketch_state("sketch", kll_init(capacity=capacity, seed=seed, max_items=max_items), kll_merge)
+
+    def update(self, values) -> None:
+        self._store_sketch_tree("sketch", kll_update(self.sketch_tree("sketch"), values))
+
+    @property
+    def n_items(self) -> int:
+        """Items folded in so far (host-side read)."""
+        return int(np.asarray(self.sketch_tree("sketch")["n"]))
+
+    def rank_error_bound(self) -> float:
+        """Worst-case normalized rank error of current estimates."""
+        return kll_rank_error_bound(max(self.n_items, 1), self.capacity)
+
+    def reset(self) -> None:
+        super().reset()
+        # re-arm the compaction baseline: after reset the update count climbs
+        # back through old values, so a stale mark would gate off every pull
+        self._nc_seen = 0
+        self._nc_count_mark = -1
+
+    def _flush_host_buffers(self) -> None:
+        super()._flush_host_buffers()
+        self._report_sketch_compactions()
+
+    def _report_sketch_compactions(self) -> None:
+        if self.__dict__.get("_state_swapped") or "_state" not in self.__dict__:
+            return
+        nc = self._state.get("sketch__sk_nc")
+        if nc is None or isinstance(nc, jax.core.Tracer):
+            return
+        # one device pull per update-count change, not per state read
+        if self._update_count == self._nc_count_mark:
+            return
+        self._nc_count_mark = self._update_count
+        cur = int(np.asarray(nc))
+        if cur > self._nc_seen:
+            _obs.counter_inc(
+                "streaming.sketch_compactions", cur - self._nc_seen, metric=type(self).__name__
+            )
+        # cur < seen means a reset or an unsync restored older state
+        self._nc_seen = cur
+
+
+class StreamingQuantile(SketchMetric):
+    """O(1)-state online quantile estimator.
+
+    ``update(values)`` folds a batch; ``compute()`` returns the estimated
+    ``q``-quantile(s) of everything seen — across all ranks when a
+    distributed backend is active (sketch-merge on gather).  Estimates are
+    within :meth:`rank_error_bound` normalized rank of exact, deterministic
+    worst case.
+
+    Args:
+        q: quantile(s) in [0, 1]; scalar in → scalar out.
+        capacity: per-level sketch width (even, >= 8); error ~ O(1/capacity).
+        seed: PRNG seed for compaction coin flips.
+        max_items: design stream length (sets the level count).
+    """
+
+    def __init__(self, q=0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        qs = np.atleast_1d(np.asarray(q, np.float64))
+        if qs.size == 0 or ((qs < 0.0) | (qs > 1.0)).any():
+            raise ValueError(f"quantiles must lie in [0, 1], got {q!r}")
+        self._scalar_q = np.ndim(q) == 0
+        self.q = tuple(float(x) for x in qs)
+
+    def compute(self):
+        out = kll_quantile(self.sketch_tree("sketch"), jnp.asarray(self.q, jnp.float32))
+        return out[0] if self._scalar_q else out
+
+
+class StreamingHistogram(SketchMetric):
+    """Fixed-state streaming histogram: ``compute()`` returns ``{"edges":
+    (bins+1,), "counts": (bins,)}`` over the observed [min, max] range.
+
+    Counts are sketch-estimated (CDF differences scaled by total weight), so
+    they are floats accurate to the sketch's rank-error bound; edges are
+    exact (min/max ride ordinary ``min``/``max`` reduces).
+    """
+
+    def __init__(self, bins: int = 10, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if int(bins) < 1:
+            raise ValueError(f"bins must be >= 1, got {bins}")
+        self.bins = int(bins)
+        self.add_state("minv", jnp.asarray(jnp.inf, jnp.float32), dist_reduce_fx="min")
+        self.add_state("maxv", jnp.asarray(-jnp.inf, jnp.float32), dist_reduce_fx="max")
+
+    def update(self, values) -> None:
+        vals = jnp.ravel(jnp.asarray(values, jnp.float32))
+        if vals.shape[0] == 0:
+            return
+        super().update(vals)
+        finite = jnp.isfinite(vals)
+        self.minv = jnp.minimum(self.minv, jnp.min(jnp.where(finite, vals, jnp.inf)))
+        self.maxv = jnp.maximum(self.maxv, jnp.max(jnp.where(finite, vals, -jnp.inf)))
+
+    def compute(self) -> Dict[str, Any]:
+        tree = self.sketch_tree("sketch")
+        lo = jnp.asarray(self.minv, jnp.float32)
+        hi = jnp.asarray(self.maxv, jnp.float32)
+        # degenerate (single value / empty) ranges still need increasing edges
+        hi = jnp.where(hi > lo, hi, lo + 1.0)
+        edges = lo + (hi - lo) * jnp.linspace(0.0, 1.0, self.bins + 1)
+        total = kll_total_weight(tree)
+        upper = kll_cdf(tree, edges[1:]) * total
+        # first bin's lower edge is inclusive (it IS the observed minimum)
+        counts = jnp.diff(jnp.concatenate([jnp.zeros((1,), jnp.float32), upper]))
+        counts = jnp.where(total > 0, counts, 0.0)
+        return {"edges": edges, "counts": counts}

@@ -1,0 +1,383 @@
+"""Fixed-shape mergeable sketches for online evaluation.
+
+Two summaries with **static-shape JAX state** (no data-dependent shapes, so a
+jitted ``update`` never recompiles as the stream grows):
+
+* a KLL-style quantile sketch (`Karnin, Lang & Liberty, FOCS'16
+  <https://arxiv.org/abs/1603.05346>`_ lineage): a fixed ``(levels,
+  capacity)`` buffer where level ``h`` holds items of weight ``2**h``;
+  overflowing levels are *compacted* — sorted, and every other element
+  promoted one level up with doubled weight, the parity chosen by a coin
+  flip.  All compactions are ``lax`` ops on padded rows, so the whole update
+  is a constant-shape program.
+* an A-Res weighted reservoir sample (Efraimidis & Spirakis): each item draws
+  key ``u ** (1/w)`` and the reservoir keeps the ``capacity`` largest keys
+  via ``lax.top_k``.
+
+Both are **mergeable**: ``kll_merge`` / ``reservoir_merge`` fold any number
+of sketch states into one whose estimates are as good as a single sketch
+over the concatenated stream (within the rank-error bound).  That is what
+lets them ride the cross-host sync path as a custom ``"sketch"`` reduce.
+
+State layout invariants (relied on by merge and by the Metric sync path):
+
+* ``buf`` rows keep their ``cnt[h]`` valid entries contiguous at the row
+  start; every slot at index ``>= cnt[h]`` holds ``+inf`` padding.
+* non-finite inputs (nan/±inf) are filtered at insert and never enter a row.
+* every leaf is a fixed-shape array — the state pytree can be stacked,
+  vmapped (ring buffers of sketches), donated, and packed into sync blobs.
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "DEFAULT_MAX_ITEMS",
+    "kll_init",
+    "kll_update",
+    "kll_merge",
+    "kll_quantile",
+    "kll_cdf",
+    "kll_total_weight",
+    "kll_rank_error_bound",
+    "reservoir_init",
+    "reservoir_update",
+    "reservoir_merge",
+    "reservoir_values",
+    "bootstrap_resample_indices",
+]
+
+DEFAULT_CAPACITY = 256
+# design stream length: enough levels that items only saturate the top level
+# past ~67M weighted items at the default capacity
+DEFAULT_MAX_ITEMS = 1 << 26
+
+_INF = float("inf")
+
+
+def _num_levels(capacity: int, max_items: int) -> int:
+    """Smallest level count whose total capacity ``K * (2**L - 1)`` covers
+    ``max_items`` weighted items; at least 4 so small sketches still have
+    headroom to compact."""
+    levels = 4
+    while capacity * ((1 << levels) - 1) < max_items:
+        levels += 1
+    return levels
+
+
+def kll_init(capacity: int = DEFAULT_CAPACITY, seed: int = 0, max_items: int = DEFAULT_MAX_ITEMS) -> Dict[str, Any]:
+    """Fresh KLL state: ``buf (L, K)`` +inf-padded, per-level counts, PRNG
+    key, item count ``n``, compaction count ``nc``.
+
+    ``capacity`` must be an even integer >= 8: compactions move exactly
+    ``K // 2`` survivors, and the trigger ``cnt > K - K//2`` needs slack.
+    """
+    if capacity < 8 or capacity % 2:
+        raise ValueError(f"sketch capacity must be an even integer >= 8, got {capacity}")
+    levels = _num_levels(capacity, max_items)
+    return {
+        "buf": jnp.full((levels, capacity), _INF, jnp.float32),
+        "cnt": jnp.zeros((levels,), jnp.int32),
+        "key": jax.random.PRNGKey(seed),
+        "n": jnp.zeros((), jnp.int32),
+        "nc": jnp.zeros((), jnp.int32),
+    }
+
+
+def _compact_level(buf, cnt, nc, rbit, h):
+    """Compact level ``h``: sort, keep every other element (parity ``rbit``),
+    push survivors one level up with doubled weight.  The top level compacts
+    in place — survivors keep the top weight, which only degrades accuracy
+    once the stream exceeds the ``max_items`` design point."""
+    levels, capacity = buf.shape
+    half = capacity // 2
+    srow = jnp.sort(buf[h])
+    picks = srow[rbit + 2 * jnp.arange(half)]
+    n_surv = jnp.maximum((cnt[h] + 1 - rbit) // 2, 0).astype(jnp.int32)
+    picks = jnp.where(jnp.arange(half) < n_surv, picks, _INF)
+    if h + 1 < levels:
+        # space is guaranteed: levels are compacted top-down, so h+1 already
+        # holds at most capacity - half entries when h spills into it
+        nxt = lax.dynamic_update_slice(buf[h + 1], picks, (cnt[h + 1],))
+        buf = buf.at[h].set(jnp.full((capacity,), _INF, buf.dtype)).at[h + 1].set(nxt)
+        cnt = cnt.at[h].set(0).at[h + 1].add(n_surv)
+    else:
+        top = jnp.full((capacity,), _INF, buf.dtype).at[:half].set(picks)
+        buf = buf.at[h].set(top)
+        cnt = cnt.at[h].set(n_surv)
+    return buf, cnt, nc + 1
+
+
+def _maybe_compact(buf, cnt, nc, rbit, h):
+    capacity = buf.shape[1]
+    half = capacity // 2
+    return lax.cond(
+        cnt[h] > capacity - half,
+        lambda b, c, m, r: _compact_level(b, c, m, r, h),
+        lambda b, c, m, r: (b, c, m),
+        buf, cnt, nc, rbit,
+    )
+
+
+def _fold_chunks(buf, cnt, key, nc, chunks, valids, level):
+    """Scan fixed-width chunks into ``buf`` at ``level``.
+
+    Each chunk carries ``valid <= capacity // 2`` real entries contiguous at
+    its start (the rest +inf).  Before inserting, a top-down compaction pass
+    over levels ``L-1 .. level`` guarantees the target row has room for a
+    full half-row — so insertion is a single ``dynamic_update_slice`` and
+    the whole body is constant-shape.
+    """
+    levels, capacity = buf.shape
+    half = capacity // 2
+
+    def body(carry, xs):
+        buf, cnt, key, nc = carry
+        chunk, valid = xs
+        key, sub = jax.random.split(key)
+        rbits = jax.random.randint(sub, (levels,), 0, 2, dtype=jnp.int32)
+        for h in range(levels - 1, level - 1, -1):
+            buf, cnt, nc = _maybe_compact(buf, cnt, nc, rbits[h], h)
+        masked = jnp.where(jnp.arange(half) < valid, chunk, _INF).astype(buf.dtype)
+        row = lax.dynamic_update_slice(buf[level], masked, (cnt[level],))
+        buf = buf.at[level].set(row)
+        cnt = cnt.at[level].add(valid)
+        return (buf, cnt, key, nc), None
+
+    (buf, cnt, key, nc), _ = lax.scan(body, (buf, cnt, key, nc), (chunks, valids))
+    return buf, cnt, key, nc
+
+
+def kll_update(state: Dict[str, Any], values) -> Dict[str, Any]:
+    """Fold a batch of values into the sketch (weight-1 items at level 0).
+
+    Non-finite values are dropped.  Pure constant-shape ``jnp``/``lax`` — safe
+    under jit/vmap/scan, and the output shapes match the input state exactly.
+    """
+    vals = jnp.ravel(jnp.asarray(values))
+    if vals.shape[0] == 0:
+        return dict(state)
+    buf, cnt, key, n, nc = state["buf"], state["cnt"], state["key"], state["n"], state["nc"]
+    half = buf.shape[1] // 2
+    vals = vals.astype(buf.dtype)
+    vals = jnp.where(jnp.isfinite(vals), vals, _INF)
+    nchunk = -(-vals.shape[0] // half)
+    pad = nchunk * half - vals.shape[0]
+    if pad:
+        vals = jnp.concatenate([vals, jnp.full((pad,), _INF, buf.dtype)])
+    chunks_raw = vals.reshape(nchunk, half)
+    # per-chunk sort makes valid entries contiguous (non-finite sort to +inf
+    # at the end) so insertion stays a single slice write
+    chunks = jnp.sort(chunks_raw, axis=1)
+    valids = jnp.sum(jnp.isfinite(chunks_raw), axis=1).astype(jnp.int32)
+    buf, cnt, key, nc = _fold_chunks(buf, cnt, key, nc, chunks, valids, 0)
+    return {"buf": buf, "cnt": cnt, "key": key, "n": n + valids.sum(), "nc": nc}
+
+
+def _kll_merge_two(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    buf, cnt, key, nc = a["buf"], a["cnt"], a["key"], a["nc"]
+    levels, capacity = buf.shape
+    half = capacity // 2
+    for h in range(levels):
+        # level rows keep valid entries contiguous, so the two half-row
+        # chunks carry clip(cnt - half*i, 0, half) valid entries each
+        chunks = b["buf"][h].reshape(2, half)
+        valids = jnp.clip(b["cnt"][h] - half * jnp.arange(2), 0, half).astype(jnp.int32)
+        buf, cnt, key, nc = _fold_chunks(buf, cnt, key, nc, chunks, valids, h)
+    return {"buf": buf, "cnt": cnt, "key": key, "n": a["n"] + b["n"], "nc": nc + b["nc"]}
+
+
+def kll_merge(states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold any number of KLL states into one.
+
+    Commutative up to the compaction coin flips; the rank-error bound holds
+    for *any* coin outcome, so merged estimates stay within
+    :func:`kll_rank_error_bound` of the concatenated stream.  Pure
+    constant-shape ops — usable eagerly (cross-host sync), under jit, and
+    under vmap (ring buffers of sketches merge slot-wise).
+    """
+    states = list(states)
+    if not states:
+        raise ValueError("kll_merge needs at least one state")
+    out = {k: jnp.asarray(v) for k, v in states[0].items()}
+    for other in states[1:]:
+        out = _kll_merge_two(out, other)
+    return out
+
+
+def _weights(state: Dict[str, Any]):
+    buf, cnt = state["buf"], state["cnt"]
+    levels, capacity = buf.shape
+    level_w = (2.0 ** jnp.arange(levels, dtype=buf.dtype))[:, None]
+    w = jnp.where(jnp.arange(capacity)[None, :] < cnt[:, None], level_w, 0.0)
+    return buf.ravel(), w.ravel()
+
+
+def kll_total_weight(state: Dict[str, Any]):
+    """Total weight held by the sketch (= items folded in, until the top
+    level saturates past the design stream length)."""
+    _, w = _weights(state)
+    return w.sum()
+
+
+def kll_quantile(state: Dict[str, Any], q):
+    """Estimated ``q``-quantile(s); scalar in, scalar out.  NaN when empty."""
+    vals, w = _weights(state)
+    order = jnp.argsort(vals)
+    sv, cw = vals[order], jnp.cumsum(w[order])
+    total = cw[-1]
+    qa = jnp.atleast_1d(jnp.asarray(q, vals.dtype))
+    idx = jnp.clip(jnp.searchsorted(cw, qa * total, side="left"), 0, vals.shape[0] - 1)
+    out = jnp.where(total > 0, sv[idx], jnp.nan)
+    return out.reshape(()) if jnp.ndim(q) == 0 else out
+
+
+def kll_cdf(state: Dict[str, Any], xs):
+    """Estimated CDF (fraction of weight ``<= x``) at each ``x``; NaN when
+    the sketch is empty."""
+    vals, w = _weights(state)
+    xa = jnp.atleast_1d(jnp.asarray(xs, vals.dtype))
+    total = w.sum()
+    below = jnp.sum(jnp.where(vals[None, :] <= xa[:, None], w[None, :], 0.0), axis=1)
+    out = jnp.where(total > 0, below / jnp.maximum(total, 1.0), jnp.nan)
+    return out.reshape(()) if jnp.ndim(xs) == 0 else out
+
+
+def kll_rank_error_bound(n: int, capacity: int = DEFAULT_CAPACITY) -> float:
+    """Worst-case normalized rank error ε after ``n`` items.
+
+    Exact (up to discretization) while everything fits uncompacted
+    (``n <= capacity``).  Beyond that: a level-``h`` compaction perturbs any
+    rank by at most ``2**h / 2``, and at most ``2n / (capacity * 2**h)``
+    compactions happen at level ``h`` — summing over the ``H ≈
+    log2(2n/capacity)`` active levels gives ``H * n / capacity`` absolute
+    rank error, i.e. ε ``= (H + 2) / capacity`` with slack for ties.  This
+    is the deterministic worst case over all coin flips; typical error is
+    far smaller.
+    """
+    n = int(n)
+    if n <= 0:
+        return 0.0
+    if n <= capacity:
+        return 1.0 / n
+    levels = math.ceil(math.log2(max(2.0 * n / capacity, 2.0)))
+    return min(1.0, (levels + 2) / capacity)
+
+
+# ---------------------------------------------------------------------------
+# weighted reservoir (A-Res)
+# ---------------------------------------------------------------------------
+
+
+def reservoir_init(capacity: int = 128, seed: int = 0, distinct: bool = True) -> Dict[str, Any]:
+    """Fresh A-Res weighted reservoir.
+
+    ``distinct=True`` folds the process index into the seed so ranks that
+    construct identically-seeded reservoirs still draw independent keys —
+    merging reservoirs is only a uniform sample when keys are independent.
+    """
+    if capacity < 1:
+        raise ValueError(f"reservoir capacity must be >= 1, got {capacity}")
+    key = jax.random.PRNGKey(seed)
+    if distinct:
+        key = jax.random.fold_in(key, jax.process_index())
+    return {
+        "rvals": jnp.zeros((capacity,), jnp.float32),
+        "rkeys": jnp.full((capacity,), -_INF, jnp.float32),
+        "rkey": key,
+        "rseen": jnp.zeros((), jnp.int32),
+    }
+
+
+def reservoir_update(state: Dict[str, Any], values, weights=None) -> Dict[str, Any]:
+    """Fold a batch into the reservoir: each item draws key ``u ** (1/w)``
+    and the ``capacity`` largest keys survive.  Non-finite values and
+    non-positive weights are dropped."""
+    vals = jnp.ravel(jnp.asarray(values)).astype(jnp.float32)
+    m = vals.shape[0]
+    if m == 0:
+        return dict(state)
+    if weights is None:
+        w = jnp.ones((m,), jnp.float32)
+    else:
+        w = jnp.broadcast_to(jnp.ravel(jnp.asarray(weights)).astype(jnp.float32), (m,))
+    key, sub = jax.random.split(state["rkey"])
+    u = jax.random.uniform(sub, (m,), minval=1e-7, maxval=1.0)
+    keys = u ** (1.0 / jnp.maximum(w, 1e-30))
+    ok = jnp.isfinite(vals) & jnp.isfinite(w) & (w > 0)
+    keys = jnp.where(ok, keys, -_INF)
+    allk = jnp.concatenate([state["rkeys"], keys])
+    allv = jnp.concatenate([state["rvals"], vals])
+    capacity = state["rkeys"].shape[0]
+    topk, topi = lax.top_k(allk, capacity)
+    return {
+        "rvals": allv[topi],
+        "rkeys": topk,
+        "rkey": key,
+        "rseen": state["rseen"] + ok.sum().astype(jnp.int32),
+    }
+
+
+def reservoir_merge(states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Keep the ``capacity`` largest keys across all reservoirs — exactly the
+    sample a single reservoir over the union would have kept."""
+    states = list(states)
+    if not states:
+        raise ValueError("reservoir_merge needs at least one state")
+    capacity = jnp.asarray(states[0]["rkeys"]).shape[0]
+    allk = jnp.concatenate([jnp.asarray(s["rkeys"]) for s in states])
+    allv = jnp.concatenate([jnp.asarray(s["rvals"]) for s in states])
+    topk, topi = lax.top_k(allk, capacity)
+    rseen = sum(jnp.asarray(s["rseen"]) for s in states)
+    return {
+        "rvals": allv[topi],
+        "rkeys": topk,
+        "rkey": jnp.asarray(states[0]["rkey"]),
+        "rseen": jnp.asarray(rseen, jnp.int32),
+    }
+
+
+def reservoir_values(state: Dict[str, Any]):
+    """``(values, valid_mask)`` — fixed-shape; mask is False for unfilled
+    slots."""
+    return state["rvals"], state["rkeys"] > -_INF
+
+
+# ---------------------------------------------------------------------------
+# vectorized bootstrap resampling (numpy, host-side)
+# ---------------------------------------------------------------------------
+
+
+def bootstrap_resample_indices(
+    rng: np.random.Generator,
+    size: int,
+    num_copies: int,
+    sampling_strategy: str = "multinomial",
+):
+    """Resample indices for all ``num_copies`` bootstrap copies in ONE
+    generator draw.
+
+    numpy ``Generator`` fills arrays row-major from the same underlying
+    stream, so the vectorized draw is *stream-identical* to ``num_copies``
+    sequential per-copy draws — callers can swap a per-copy Python loop for
+    this without changing results (asserted by the equivalence tests).
+
+    Returns a ``(num_copies, size)`` index array for ``"multinomial"``; for
+    ``"poisson"`` a list of per-copy variable-length index arrays (copy
+    ``i`` repeats index ``j`` ``counts[i, j]`` times).
+    """
+    if size < 1 or num_copies < 1:
+        raise ValueError("size and num_copies must be positive")
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size=(num_copies, size))
+    if sampling_strategy == "poisson":
+        counts = rng.poisson(1.0, size=(num_copies, size))
+        base = np.arange(size)
+        return [np.repeat(base, counts[i]) for i in range(num_copies)]
+    raise ValueError(f"unknown sampling strategy: {sampling_strategy!r}")

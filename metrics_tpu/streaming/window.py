@@ -1,0 +1,258 @@
+"""Windowed and time-decayed wrappers: recent-history evaluation with
+fixed-shape state.
+
+``WindowedMetric`` keeps a **ring buffer of per-bucket state pytrees**: every
+base-metric state is stored with a leading ``(window_size,)`` bucket dim, a
+traced write pointer selects the live bucket with ``lax.dynamic_*`` ops, and
+:meth:`~WindowedMetric.advance` rotates the ring — eviction resets one
+bucket slice in place, never reallocates, so the jitted update never sees a
+shape change and stays at zero recompiles no matter how many buckets the
+stream advances through.
+
+``TimeDecayedMetric`` is the O(1) alternative when bucket boundaries don't
+matter: an exponential moving average over per-update compute values with a
+configurable half-life.
+"""
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.obs import core as _obs
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["WindowedMetric", "TimeDecayedMetric"]
+
+_WINDOW_FXS = ("sum", "mean", "max", "min")
+
+
+class _VmappedMerge:
+    """Slot-wise (vmapped) sketch merge for ring buffers of sketches.
+
+    A module-level class (not a closure) so windowed metrics stay
+    deepcopy/pickle-friendly as long as the base merge_fn is.
+    """
+
+    def __init__(self, merge_fn):
+        self.merge_fn = merge_fn
+
+    def __call__(self, trees):
+        trees = list(trees)
+        if len(trees) == 1:
+            return dict(trees[0])
+        fn = self.merge_fn
+        return jax.vmap(lambda *ts: fn(list(ts)))(*trees)
+
+
+def _reduce_identity(fx: str, dtype):
+    if fx in ("sum", "mean"):
+        return jnp.zeros((), dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf if fx == "max" else jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.min if fx == "max" else info.max, dtype)
+
+
+class WindowedMetric(Metric):
+    """Evaluate ``metric`` over a sliding window of the last ``window_size``
+    buckets.
+
+    Updates land in the current bucket; :meth:`advance` rotates to the next
+    (evicting whatever it held a full window ago); :meth:`compute` merges
+    the active buckets — elementwise for ``sum``/``mean``/``max``/``min``
+    states, sketch-merge for sketch states — and runs the base metric's
+    ``compute`` on the merged state.
+
+    Requirements on the base metric: fixed-shape tensor states with
+    ``dist_reduce_fx`` in ``("sum", "mean", "max", "min")`` and/or sketch
+    states; no list or buffer states (their per-bucket shapes would be
+    data-dependent, defeating the zero-recompile ring), and updates must
+    live entirely in registered states.
+
+    Cross-rank sync reduces bucket-for-bucket (every rank's bucket ``i``
+    merges with every other rank's bucket ``i``), which assumes ranks call
+    :meth:`advance` in lockstep — the natural "advance once per eval step
+    on every host" pattern.
+    """
+
+    full_state_update = True
+
+    def __init__(self, metric: Metric, window_size: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise MetricsTPUUserError(
+                f"WindowedMetric expects a Metric instance, got {type(metric).__name__}"
+            )
+        if int(window_size) < 1:
+            raise MetricsTPUUserError(f"window_size must be >= 1, got {window_size}")
+        if metric._buffer_states or metric._has_list_state():
+            raise MetricsTPUUserError(
+                "WindowedMetric requires fixed-shape base states; list/buffer "
+                "states grow with the stream — use a sketch-state metric "
+                "(e.g. StreamingQuantile) for unbounded inputs"
+            )
+        sketch_leaves = metric._sketch_leaf_key_set()
+        for name, fx in metric._reduce_fns.items():
+            if name in sketch_leaves:
+                continue
+            if fx not in _WINDOW_FXS:
+                raise MetricsTPUUserError(
+                    f"WindowedMetric cannot window state {name!r} with "
+                    f"dist_reduce_fx {fx!r}; bucket merges need one of "
+                    f"{_WINDOW_FXS} or a sketch state"
+                )
+        self._base = metric
+        self.window_size = int(window_size)
+        w = self.window_size
+
+        def stack_default(value):
+            arr = jnp.asarray(value)
+            return jnp.broadcast_to(arr[None], (w,) + arr.shape)
+
+        # sketch states ride the same ring: stacking the leaf arrays gives a
+        # (window,)-leading tree, and the per-bucket merge is the base merge
+        # vmapped over the bucket dim.  Naming lines up on purpose:
+        # "wb_" + sname's leaf key  ==  "wb_" + (base leaf key).
+        for sname, smeta in metric._sketch_states.items():
+            stacked = {
+                leaf: stack_default(metric._defaults[f"{sname}__sk_{leaf}"])
+                for leaf in smeta["leaves"]
+            }
+            self.add_sketch_state("wb_" + sname, stacked, _VmappedMerge(smeta["merge"]))
+        for name, default in metric._defaults.items():
+            if name in sketch_leaves:
+                continue
+            self.add_state("wb_" + name, stack_default(default), dist_reduce_fx=metric._reduce_fns[name])
+        self.add_state("w__ptr", jnp.zeros((), jnp.int32), dist_reduce_fx="max")
+        self.add_state("w__count", jnp.zeros((w,), jnp.int32), dist_reduce_fx="sum")
+        self._base_keys: List[str] = list(metric._defaults)
+
+    def _pre_update(self, *args: Any, **kwargs: Any) -> None:
+        self._base._pre_update(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        ptr = jnp.asarray(self.__dict__["_state"]["w__ptr"])
+        state = self.__dict__["_state"]
+        slot = {
+            k: lax.dynamic_index_in_dim(jnp.asarray(state["wb_" + k]), ptr, 0, keepdims=False)
+            for k in self._base_keys
+        }
+        new_slot = self._base.apply_update(slot, *args, **kwargs)
+        for k in self._base_keys:
+            state["wb_" + k] = lax.dynamic_update_index_in_dim(
+                jnp.asarray(state["wb_" + k]), new_slot[k], ptr, 0
+            )
+        state["w__count"] = jnp.asarray(state["w__count"]).at[ptr].add(1)
+
+    def advance(self) -> int:
+        """Rotate to the next bucket, evicting its previous contents.
+
+        Host-side (eager): flushes pending updates, resets the incoming
+        bucket's slice in place — same shapes, so jitted updates keep their
+        traces — and moves the pointer.  Returns the number of updates the
+        evicted bucket held.
+        """
+        self._flush_pending()
+        w = self.window_size
+        new_ptr = (int(np.asarray(self._state["w__ptr"])) + 1) % w
+        evicted = int(np.asarray(self._state["w__count"])[new_ptr])
+        if evicted > 0:
+            _obs.counter_inc(
+                "streaming.window_evictions", metric=type(self._base).__name__
+            )
+        for k in self._base_keys:
+            default = jnp.asarray(self._base._defaults[k])
+            self._state["wb_" + k] = jnp.asarray(self._state["wb_" + k]).at[new_ptr].set(default)
+        self._state["w__count"] = jnp.asarray(self._state["w__count"]).at[new_ptr].set(0)
+        self._state["w__ptr"] = jnp.asarray(new_ptr, jnp.int32)
+        self._computed = None
+        return evicted
+
+    def window_counts(self) -> np.ndarray:
+        """Per-bucket update counts (host-side; current bucket last)."""
+        self._flush_pending()
+        counts = np.asarray(self._state["w__count"])
+        ptr = int(np.asarray(self._state["w__ptr"]))
+        return np.roll(counts, -ptr - 1)
+
+    def compute(self):
+        state = self.__dict__["_state"]
+        counts = jnp.asarray(state["w__count"])
+        active = counts > 0
+        total = jnp.maximum(counts.sum(), 1)
+        merged: Dict[str, Any] = {}
+        for sname, smeta in self._base._sketch_states.items():
+            slot_trees = [
+                {leaf: jnp.asarray(state[f"wb_{sname}__sk_{leaf}"])[i] for leaf in smeta["leaves"]}
+                for i in range(self.window_size)
+            ]
+            # empty (default) sketches are merge identities, so inactive
+            # buckets fold in harmlessly
+            tree = smeta["merge"](slot_trees) if len(slot_trees) > 1 else slot_trees[0]
+            for leaf in smeta["leaves"]:
+                merged[f"{sname}__sk_{leaf}"] = tree[leaf]
+        for k in self._base_keys:
+            if k in merged:
+                continue
+            fx = self._base._reduce_fns[k]
+            stacked = jnp.asarray(state["wb_" + k])
+            mask = active.reshape((self.window_size,) + (1,) * (stacked.ndim - 1))
+            ident = _reduce_identity(fx, stacked.dtype)
+            if fx == "sum":
+                merged[k] = jnp.sum(jnp.where(mask, stacked, ident), axis=0)
+            elif fx == "mean":
+                wts = counts.astype(stacked.dtype).reshape(mask.shape)
+                merged[k] = jnp.sum(stacked * wts, axis=0) / total.astype(stacked.dtype)
+            elif fx == "max":
+                merged[k] = jnp.max(jnp.where(mask, stacked, ident), axis=0)
+            else:
+                merged[k] = jnp.min(jnp.where(mask, stacked, ident), axis=0)
+        return self._base.apply_compute(merged)
+
+
+class TimeDecayedMetric(Metric):
+    """Exponentially time-decayed view of ``metric``: each ``update`` batch
+    contributes its own compute value, and older batches decay with the
+    configured half-life (in updates).
+
+    ``compute`` returns the EMA ``sum(d**age * value) / sum(d**age)`` with
+    ``d = 0.5 ** (1 / half_life)`` — O(1) state (two scalars per output
+    element), no buckets.  The base metric must produce a numeric (array)
+    compute value.
+    """
+
+    full_state_update = True
+
+    def __init__(self, metric: Metric, half_life: float = 100.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise MetricsTPUUserError(
+                f"TimeDecayedMetric expects a Metric instance, got {type(metric).__name__}"
+            )
+        if not float(half_life) > 0:
+            raise MetricsTPUUserError(f"half_life must be > 0, got {half_life}")
+        self._base = metric
+        self.half_life = float(half_life)
+        self.decay = 0.5 ** (1.0 / self.half_life)
+        self.add_state("ema_num", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("ema_den", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _pre_update(self, *args: Any, **kwargs: Any) -> None:
+        self._base._pre_update(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        fresh = self._base.apply_update(self._base.init_state(), *args, **kwargs)
+        value = jnp.asarray(self._base.apply_compute(fresh), jnp.float32)
+        d = jnp.float32(self.decay)
+        # 0-d init promotes to the value's shape on the first update (one
+        # deliberate retrace; shapes are stable from then on)
+        self.ema_num = self.ema_num * d + value
+        self.ema_den = self.ema_den * d + 1.0
+
+    def compute(self):
+        den = jnp.asarray(self.ema_den)
+        return jnp.asarray(self.ema_num) / jnp.maximum(den, jnp.float32(1e-12))

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.streaming.sketches import bootstrap_resample_indices
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 Array = jax.Array
@@ -181,7 +182,7 @@ class BootStrapper(Metric):
         if not self._vmap_prepare(template, args, kwargs):
             return False
         idx = jnp.asarray(
-            self._rng.integers(0, size, size=(self.num_bootstraps, size))
+            bootstrap_resample_indices(self._rng, size, self.num_bootstraps, "multinomial")
         )
         self._ensure_stacked_state()
         if self._vmapped_update is None:
@@ -306,8 +307,14 @@ class BootStrapper(Metric):
                 self._vmap_active = True
                 return
             self._vmap_active = False
+        # one vectorized generator draw for every replica (stream-identical
+        # to the old per-copy `_bootstrap_sampler` loop — numpy Generators
+        # fill row-major, asserted by the equivalence test)
+        all_rows = bootstrap_resample_indices(
+            self._rng, size, self.num_bootstraps, self.sampling_strategy
+        )
         for idx in range(self.num_bootstraps):
-            raw_idx = _bootstrap_sampler(self._rng, size, self.sampling_strategy)
+            raw_idx = np.asarray(all_rows[idx])
             if raw_idx.size == 0:  # empty poisson resample would NaN-poison the clone
                 continue
             sample_idx = jnp.asarray(raw_idx)

@@ -55,7 +55,36 @@ class MetricTracker:
 
     def increment(self) -> None:
         self._increment_called = True
-        self._steps.append(deepcopy(self._base_metric))
+        new = deepcopy(self._base_metric)
+        if self._steps:
+            self._carry_window_state(self._steps[-1], new)
+        self._steps.append(new)
+
+    @staticmethod
+    def _carry_window_state(prev: Union[Metric, MetricCollection], new: Union[Metric, MetricCollection]) -> None:
+        """Carry ``WindowedMetric`` members' ring buffers into the next step.
+
+        A fresh base copy starts with an empty window, so snapshotting the
+        base would clobber the sliding history the window exists to keep:
+        each tracker step must see the last ``window_size`` buckets, not just
+        the buckets opened since its own ``increment()``.  Other members keep
+        the reference per-step semantics (fresh state every step).
+        """
+        from metrics_tpu.streaming.window import WindowedMetric
+
+        if isinstance(prev, MetricCollection):
+            pairs = [(prev[k], new[k]) for k in prev.keys(keep_base=True)]
+        else:
+            pairs = [(prev, new)]
+        for pm, nm in pairs:
+            if not isinstance(pm, WindowedMetric):
+                continue
+            pm._flush_pending()
+            # copy, not alias: the new step's jitted update donates its state
+            # buffers, which would invalidate the previous step's arrays
+            nm._state.update({k: jnp.array(v, copy=True) for k, v in pm._state.items()})
+            nm._update_count = pm._update_count
+            nm._computed = None
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         self._check_for_increment("forward")

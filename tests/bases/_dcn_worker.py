@@ -23,6 +23,15 @@ simulation):
   must match the full union every round, a one-rank cache invalidation must
   force the WHOLE fleet back to a full gather via the pre-flight vote, and
   wire bytes must stay O(rows appended), not O(rows accumulated).
+
+Streaming scenario:
+
+* ``sketch`` — each rank folds a disjoint shard into a
+  :class:`StreamingQuantile` KLL sketch; ``compute()`` must gather peer
+  sketches over the MultihostBackend and merge them, so every rank's
+  quantiles land within the sketch's rank-error bound of the exact
+  quantiles of the UNION stream, and unsync must restore the local-only
+  sketch afterwards.
 """
 
 import os
@@ -155,6 +164,48 @@ def _scenario_delta(rank: int, nproc: int) -> None:
     print(f"DCN_DELTA_OK rank={rank}", flush=True)
 
 
+def _scenario_sketch(rank: int, nproc: int) -> None:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu.obs import counters_snapshot
+    from metrics_tpu.streaming import StreamingQuantile
+    from metrics_tpu.streaming.sketches import kll_rank_error_bound
+
+    def shard_for(r: int) -> np.ndarray:
+        # disjoint per-rank distributions: merged quantiles differ wildly
+        # from any single rank's, so a silently-local compute cannot pass
+        rng = np.random.default_rng(4000 + r)
+        return rng.normal(loc=10.0 * r, scale=3.0, size=20_000).astype(np.float32)
+
+    shard = shard_for(rank)
+    qs = (0.1, 0.5, 0.9)
+    m = StreamingQuantile(q=qs, seed=rank)  # autodetected MultihostBackend
+    for chunk in np.split(shard, 10):
+        m.update(jnp.asarray(chunk))
+    got = np.asarray(m.compute())
+
+    union = np.sort(np.concatenate([shard_for(r) for r in range(nproc)]))
+    n = union.size
+    eps = kll_rank_error_bound(n, m.capacity)
+    for q, est in zip(qs, got):
+        # the estimate's normalized rank in the union must be within eps of q
+        r_lo = np.searchsorted(union, est, side="left") / n
+        r_hi = np.searchsorted(union, est, side="right") / n
+        assert r_lo - eps <= q <= r_hi + eps, (q, est, r_lo, r_hi, eps)
+
+    merges = sum(
+        v
+        for (name, _labels), v in counters_snapshot().items()
+        if name == "streaming.sketch_merge_calls"
+    )
+    assert merges >= 1, f"sync never hit the sketch-merge path (merges={merges})"
+    # unsync restored the local-only sketch: item count is the shard's again
+    assert not m._is_synced
+    assert m.n_items == shard.size, (m.n_items, shard.size)
+    print(f"DCN_SKETCH_OK rank={rank}", flush=True)
+
+
 def main() -> None:
     rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -173,6 +224,9 @@ def main() -> None:
         return
     if scenario == "delta":
         _scenario_delta(rank, nproc)
+        return
+    if scenario == "sketch":
+        _scenario_sketch(rank, nproc)
         return
     import numpy as np
     import jax.numpy as jnp

@@ -176,6 +176,17 @@ def test_multihost_delta_sync_two_process():
         assert f"DCN_DELTA_OK rank={r}" in out
 
 
+def test_multihost_sketch_merge_two_process():
+    """Real 2-process sketch sync: each rank folds a disjoint distribution
+    into a ``StreamingQuantile`` KLL sketch; compute must gather and MERGE
+    peer sketches (not sum/cat them), landing every rank's quantiles within
+    the sketch's rank-error bound of the exact union quantiles, and unsync
+    must restore the local-only sketch."""
+    for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="sketch", timeout=120)):
+        assert code == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_SKETCH_OK rank={r}" in out
+
+
 def test_multihost_uneven_gather_unit():
     """Unit test of the pad→gather→trim scheme against a faked stacked gather
     honoring the real ``process_allgather`` contract ``(P,) + x.shape``

@@ -1,0 +1,147 @@
+"""StreamingQuantile / StreamingHistogram metrics: accuracy, merge, sync, obs."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import StreamingHistogram, StreamingQuantile
+from metrics_tpu.obs import counter_value, counters_snapshot
+from metrics_tpu.parallel.backend import LoopbackBackend
+from metrics_tpu.streaming.sketches import kll_rank_error_bound
+
+
+def _rank_error(sorted_data, q, estimate):
+    n = sorted_data.size
+    lo = np.searchsorted(sorted_data, estimate, side="left") / n
+    hi = np.searchsorted(sorted_data, estimate, side="right") / n
+    return 0.0 if lo <= q <= hi else min(abs(lo - q), abs(hi - q))
+
+
+def _trace_count(cls_name):
+    return sum(
+        v
+        for (name, labels), v in counters_snapshot().items()
+        if name == "jit_traces" and dict(labels).get("metric") == cls_name
+    )
+
+
+class TestStreamingQuantile:
+    def test_median_close_to_exact(self):
+        data = np.random.default_rng(0).normal(size=50_000).astype(np.float32)
+        m = StreamingQuantile(q=0.5)
+        for chunk in np.split(data, 10):
+            m.update(jnp.asarray(chunk))
+        got = float(m.compute())
+        eps = kll_rank_error_bound(data.size, m.capacity)
+        assert _rank_error(np.sort(data), 0.5, got) <= eps
+
+    def test_multi_q_shape_and_order(self):
+        data = np.arange(10_000, dtype=np.float32)
+        m = StreamingQuantile(q=(0.1, 0.5, 0.9))
+        m.update(jnp.asarray(data))
+        out = np.asarray(m.compute())
+        assert out.shape == (3,)
+        assert out[0] < out[1] < out[2]
+
+    def test_validates_q(self):
+        with pytest.raises(ValueError):
+            StreamingQuantile(q=1.5)
+        with pytest.raises(ValueError):
+            StreamingQuantile(q=(0.5, -0.1))
+        with pytest.raises(ValueError):
+            StreamingQuantile(q=())
+
+    def test_reset_clears_stream(self):
+        m = StreamingQuantile(q=0.5)
+        m.update(jnp.arange(100.0))
+        m.reset()
+        assert m.n_items == 0
+        assert np.isnan(float(m.compute()))
+
+    def test_merge_state_multi_way(self):
+        rng = np.random.default_rng(1)
+        shards = [rng.normal(loc=3.0 * i, size=5_000).astype(np.float32) for i in range(3)]
+        # smaller design length -> fewer sketch levels -> cheap eager merge
+        ms = [StreamingQuantile(q=0.5, seed=i, max_items=1 << 17) for i in range(3)]
+        for m, shard in zip(ms, shards):
+            m.update(jnp.asarray(shard))
+        for m in ms[1:]:
+            m._flush_pending()  # merge_state flushes SELF only
+        ms[0].merge_state([ms[1]._state, ms[2]._state])
+        union = np.sort(np.concatenate(shards))
+        assert ms[0].n_items == union.size
+        got = float(ms[0].compute())
+        assert _rank_error(union, 0.5, got) <= kll_rank_error_bound(union.size, 256)
+        # donors keep their local streams
+        assert ms[1].n_items == 5_000
+
+    def test_loopback_sync_hits_merge_path(self):
+        data = np.random.default_rng(2).normal(size=2_000).astype(np.float32)
+        m = StreamingQuantile(q=0.5, sync_backend=LoopbackBackend())
+        m.update(jnp.asarray(data))
+        before = counter_value("streaming.sketch_merge_calls", metric="StreamingQuantile")
+        got = float(m.compute())
+        after = counter_value("streaming.sketch_merge_calls", metric="StreamingQuantile")
+        assert after == before + 1
+        assert _rank_error(np.sort(data), 0.5, got) <= kll_rank_error_bound(data.size, 256)
+        # unsync restored the local sketch
+        assert not m._is_synced
+        assert m.n_items == data.size
+
+    def test_compaction_counter_surfaces_and_rearms_on_reset(self):
+        m = StreamingQuantile(q=0.5)
+        data = jnp.asarray(np.random.default_rng(3).normal(size=4_096), jnp.float32)
+
+        def stream():
+            for chunk in jnp.split(data, 8):
+                m.update(chunk)
+            m.compute()
+
+        stream()
+        first = counter_value("streaming.sketch_compactions", metric="StreamingQuantile")
+        assert first > 0
+        m.reset()
+        stream()  # identical stream after reset must count again
+        second = counter_value("streaming.sketch_compactions", metric="StreamingQuantile")
+        assert second > first
+
+    def test_zero_recompiles_after_warmup(self):
+        m = StreamingQuantile(q=0.5, lazy_updates=0)
+        x = jnp.arange(1_024.0)
+        m.update(x)  # warmup trace
+        warm = _trace_count("StreamingQuantile")
+        for i in range(20):
+            m.update(x + i)
+        assert _trace_count("StreamingQuantile") == warm
+
+
+class TestStreamingHistogram:
+    def test_counts_close_to_numpy(self):
+        data = np.random.default_rng(4).normal(size=40_000).astype(np.float32)
+        m = StreamingHistogram(bins=10)
+        for chunk in np.split(data, 8):
+            m.update(jnp.asarray(chunk))
+        out = m.compute()
+        edges = np.asarray(out["edges"])
+        counts = np.asarray(out["counts"])
+        assert edges.shape == (11,)
+        assert counts.shape == (10,)
+        assert edges[0] == pytest.approx(data.min())
+        assert edges[-1] == pytest.approx(data.max())
+        assert counts.sum() == pytest.approx(data.size, rel=0.01)
+        want, _ = np.histogram(data, bins=edges)
+        np.testing.assert_allclose(counts, want, atol=0.05 * data.size)
+
+    def test_empty_and_degenerate_streams(self):
+        m = StreamingHistogram(bins=4)
+        out = m.compute()
+        np.testing.assert_array_equal(np.asarray(out["counts"]), np.zeros(4))
+        m.update(jnp.asarray([7.0, 7.0, 7.0]))  # single-value stream
+        out = m.compute()
+        edges = np.asarray(out["edges"])
+        assert np.all(np.diff(edges) > 0)  # edges stay strictly increasing
+        assert np.asarray(out["counts"]).sum() == pytest.approx(3.0)
+
+    def test_validates_bins(self):
+        with pytest.raises(ValueError):
+            StreamingHistogram(bins=0)

@@ -1,0 +1,216 @@
+"""KLL sketch / reservoir primitives: exactness, merge property, jit stability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.streaming.sketches import (
+    bootstrap_resample_indices,
+    kll_init,
+    kll_merge,
+    kll_quantile,
+    kll_rank_error_bound,
+    kll_total_weight,
+    kll_update,
+    reservoir_init,
+    reservoir_merge,
+    reservoir_update,
+    reservoir_values,
+)
+from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+QS = np.asarray([0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99], np.float32)
+
+# eager kll_update dispatches the whole unrolled compaction graph op-by-op;
+# the long-stream tests fold same-shaped chunks, so one jitted trace (shared
+# across all tests in this module) keeps the suite fast
+_jit_update = jax.jit(kll_update)
+_jit_merge = jax.jit(kll_merge)
+
+
+def _rank_error(sorted_data, q, estimate):
+    """Normalized rank distance between ``estimate`` and the exact q-quantile."""
+    n = sorted_data.size
+    lo = np.searchsorted(sorted_data, estimate, side="left") / n
+    hi = np.searchsorted(sorted_data, estimate, side="right") / n
+    return 0.0 if lo <= q <= hi else min(abs(lo - q), abs(hi - q))
+
+
+class TestKLL:
+    def test_small_stream_is_exact(self):
+        data = np.random.default_rng(0).normal(size=200).astype(np.float32)
+        st = kll_update(kll_init(capacity=256), jnp.asarray(data))
+        assert int(st["n"]) == 200
+        got = np.asarray(kll_quantile(st, jnp.asarray(QS)))
+        want = np.quantile(data, QS, method="inverted_cdf")
+        np.testing.assert_allclose(got, want.astype(np.float32))
+
+    def test_empty_sketch_quantile_is_nan(self):
+        st = kll_init(capacity=64)
+        assert np.isnan(float(kll_quantile(st, jnp.float32(0.5))))
+        assert float(kll_total_weight(st)) == 0.0
+
+    def test_scalar_q_scalar_out(self):
+        st = kll_update(kll_init(capacity=64), jnp.arange(100.0))
+        out = kll_quantile(st, jnp.float32(0.5))
+        assert np.ndim(out) == 0
+
+    def test_nonfinite_values_dropped(self):
+        vals = jnp.asarray([1.0, jnp.nan, jnp.inf, -jnp.inf, 2.0])
+        st = kll_update(kll_init(capacity=64), vals)
+        assert int(st["n"]) == 2
+        assert float(kll_quantile(st, jnp.float32(1.0))) == 2.0
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_long_stream_within_bound(self, seed):
+        rng = np.random.default_rng(seed)
+        data = rng.lognormal(size=60_000).astype(np.float32)
+        st = kll_init(capacity=256, seed=seed)
+        for chunk in np.split(data, 20):
+            st = _jit_update(st, jnp.asarray(chunk))
+        assert int(st["n"]) == data.size
+        eps = kll_rank_error_bound(data.size, 256)
+        sorted_data = np.sort(data)
+        got = np.asarray(kll_quantile(st, jnp.asarray(QS)))
+        for q, est in zip(QS, got):
+            assert _rank_error(sorted_data, q, est) <= eps, (q, est, eps)
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    @pytest.mark.parametrize("shards", [2, 5])
+    def test_merge_property_matches_union(self, seed, shards):
+        """Sketch merged across N shards ~ one sketch over the concatenated
+        stream: the union's rank-error bound holds for the merged estimate."""
+        rng = np.random.default_rng(seed)
+        parts = [
+            rng.normal(loc=5.0 * i, scale=1.0 + i, size=15_000).astype(np.float32)
+            for i in range(shards)
+        ]
+        states = []
+        for i, part in enumerate(parts):
+            # smaller design length -> fewer levels -> cheaper merge program
+            st = kll_init(capacity=256, seed=100 + i, max_items=1 << 17)
+            for chunk in np.split(part, 5):
+                st = _jit_update(st, jnp.asarray(chunk))
+            states.append(st)
+        merged = _jit_merge(states)
+        union = np.sort(np.concatenate(parts))
+        assert int(merged["n"]) == union.size
+        eps = kll_rank_error_bound(union.size, 256)
+        got = np.asarray(kll_quantile(merged, jnp.asarray(QS)))
+        for q, est in zip(QS, got):
+            assert _rank_error(union, q, est) <= eps, (q, est, eps)
+
+    def test_merge_single_and_empty_states(self):
+        data = np.arange(1000, dtype=np.float32)
+        st = kll_update(kll_init(capacity=256, max_items=1 << 17), jnp.asarray(data))
+        alone = _jit_merge([st])
+        assert int(alone["n"]) == 1000
+        with_empty = _jit_merge([st, kll_init(capacity=256, seed=9, max_items=1 << 17)])
+        assert int(with_empty["n"]) == 1000
+        got = float(kll_quantile(with_empty, jnp.float32(0.5)))
+        assert _rank_error(data, 0.5, got) <= kll_rank_error_bound(1000, 256)
+
+    def test_update_jit_stable(self):
+        """The same-shape update traces exactly once — the zero-recompile
+        contract the whole subsystem is built on."""
+        traces = {"n": 0}
+
+        def up(st, x):
+            traces["n"] += 1
+            return kll_update(st, x)
+
+        jup = jax.jit(up)
+        st = kll_init(capacity=64)
+        x = jnp.arange(512.0)
+        for i in range(20):
+            st = jup(st, x + i)
+        assert traces["n"] == 1
+        assert int(st["n"]) == 20 * 512
+
+    def test_merge_is_vmappable(self):
+        """Stacked states merge under vmap (the WindowedMetric slot path)."""
+        sts = [
+            kll_update(kll_init(capacity=64, seed=i, max_items=1 << 12), jnp.arange(100.0) + 100 * i)
+            for i in range(3)
+        ]
+        stacked_a = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *sts[:2])
+        stacked_b = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *sts[1:])
+        merged = jax.vmap(lambda a, b: kll_merge([a, b]))(stacked_a, stacked_b)
+        assert merged["buf"].shape[0] == 2
+        # lane 0 merges s0+s1 (100 items each), lane 1 merges s1+s2
+        np.testing.assert_array_equal(np.asarray(merged["n"]), [200, 200])
+
+    def test_rank_error_bound_regimes(self):
+        assert kll_rank_error_bound(100, 256) == pytest.approx(1 / 100)
+        big = kll_rank_error_bound(10**7, 256)
+        assert 0 < big < 0.1
+        assert kll_rank_error_bound(10**7, 64) > big  # smaller sketch, worse bound
+        assert kll_rank_error_bound(2, 8) <= 1.0
+
+
+class TestReservoir:
+    def test_fills_then_subsamples(self):
+        st = reservoir_init(capacity=32, seed=0, distinct=False)
+        st = reservoir_update(st, jnp.arange(16.0))
+        vals, mask = reservoir_values(st)
+        assert int(mask.sum()) == 16
+        st = reservoir_update(st, jnp.arange(16.0, 200.0))
+        vals, mask = reservoir_values(st)
+        assert int(mask.sum()) == 32
+        assert int(st["rseen"]) == 200
+        kept = set(np.asarray(vals)[np.asarray(mask)].tolist())
+        assert kept <= set(np.arange(200.0).tolist())
+
+    def test_nonfinite_and_nonpositive_weights_dropped(self):
+        st = reservoir_init(capacity=8, seed=0, distinct=False)
+        st = reservoir_update(
+            st,
+            jnp.asarray([1.0, jnp.nan, 2.0, 3.0]),
+            weights=jnp.asarray([1.0, 1.0, 0.0, 2.0]),
+        )
+        _, mask = reservoir_values(st)
+        assert int(mask.sum()) == 2  # nan value and zero weight both dropped
+        assert int(st["rseen"]) == 2
+
+    def test_merge_keeps_top_keys(self):
+        sts = []
+        for i in range(3):
+            st = reservoir_init(capacity=16, seed=i, distinct=False)
+            sts.append(reservoir_update(st, jnp.arange(100.0) + 1000 * i))
+        merged = reservoir_merge(sts)
+        assert int(merged["rseen"]) == 300
+        _, mask = reservoir_values(merged)
+        assert int(mask.sum()) == 16
+        # merged sample == top-capacity keys over the union of all states
+        allk = np.concatenate([np.asarray(s["rkeys"]) for s in sts])
+        allv = np.concatenate([np.asarray(s["rvals"]) for s in sts])
+        want = set(allv[np.argsort(allk)[-16:]].tolist())
+        got = set(np.asarray(merged["rvals"])[np.asarray(mask)].tolist())
+        assert got == want
+
+
+class TestBootstrapIndices:
+    """The vectorized draw must be stream-identical to the sequential
+    per-copy ``_bootstrap_sampler`` loop it replaced."""
+
+    @pytest.mark.parametrize("strategy", ["multinomial", "poisson"])
+    @pytest.mark.parametrize("size,copies", [(16, 4), (100, 10), (1, 3)])
+    def test_matches_sequential_sampler_exactly(self, strategy, size, copies):
+        vec = bootstrap_resample_indices(
+            np.random.default_rng(42), size, copies, strategy
+        )
+        rng = np.random.default_rng(42)
+        seq = [_bootstrap_sampler(rng, size, strategy) for _ in range(copies)]
+        assert len(vec) == copies
+        for v, s in zip(vec, seq):
+            np.testing.assert_array_equal(np.asarray(v), s)
+
+    def test_validates_args(self):
+        rng = np.random.default_rng(0)
+        with pytest.raises(ValueError):
+            bootstrap_resample_indices(rng, 0, 4)
+        with pytest.raises(ValueError):
+            bootstrap_resample_indices(rng, 4, 0)
+        with pytest.raises(ValueError):
+            bootstrap_resample_indices(rng, 4, 4, "jackknife")

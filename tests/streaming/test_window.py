@@ -1,0 +1,193 @@
+"""WindowedMetric / TimeDecayedMetric: window math, eviction, recompiles,
+tracker and collection integration."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    MeanMetric,
+    MetricCollection,
+    MetricTracker,
+    StreamingQuantile,
+    SumMetric,
+    TimeDecayedMetric,
+    WindowedMetric,
+)
+from metrics_tpu.aggregation import CatMetric
+from metrics_tpu.obs import counter_value, counters_snapshot
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.streaming.window import TimeDecayedMetric as _TDM  # import path sanity
+
+assert _TDM is TimeDecayedMetric
+
+
+def _trace_total():
+    return sum(v for (name, _), v in counters_snapshot().items() if name == "jit_traces")
+
+
+class TestWindowedMetric:
+    def test_window_math_with_eviction(self):
+        m = WindowedMetric(MeanMetric(), window_size=3)
+        for v in (1.0, 2.0):
+            m.update(v)
+        m.advance()
+        m.update(6.0)
+        m.advance()
+        m.update(8.0)
+        # window holds buckets [1,2], [6], [8] -> mean of all updates
+        assert float(m.compute()) == pytest.approx((1 + 2 + 6 + 8) / 4)
+        evicted = m.advance()  # rotates onto the [1,2] bucket
+        assert evicted == 2
+        m.update(3.0)
+        assert float(m.compute()) == pytest.approx((6 + 8 + 3) / 3)
+
+    def test_eviction_counter(self):
+        before = counter_value("streaming.window_evictions", metric="MeanMetric")
+        m = WindowedMetric(MeanMetric(), window_size=2)
+        m.update(1.0)
+        m.advance()  # empty bucket evicted: no count
+        assert counter_value("streaming.window_evictions", metric="MeanMetric") == before
+        m.update(2.0)
+        m.advance()  # evicts the bucket holding 1.0
+        assert counter_value("streaming.window_evictions", metric="MeanMetric") == before + 1
+
+    def test_window_counts_rotation(self):
+        m = WindowedMetric(SumMetric(), window_size=3)
+        m.update(1.0)
+        m.update(1.0)
+        m.advance()
+        m.update(1.0)
+        np.testing.assert_array_equal(m.window_counts(), [0, 2, 1])
+
+    def test_sum_max_min_states_mask_correctly(self):
+        m = WindowedMetric(SumMetric(), window_size=2)
+        m.update(5.0)
+        m.advance()
+        m.update(7.0)
+        assert float(m.compute()) == pytest.approx(12.0)
+        m.advance()  # evicts 5.0
+        m.update(1.0)
+        assert float(m.compute()) == pytest.approx(8.0)
+
+    def test_windowed_sketch_rotation(self):
+        m = WindowedMetric(StreamingQuantile(q=0.5, max_items=1 << 12), window_size=2)
+        m.update(jnp.arange(0.0, 100.0))
+        assert float(m.compute()) == pytest.approx(49.0, abs=2.0)
+        m.advance()
+        m.update(jnp.arange(100.0, 200.0))
+        # both buckets live: median over 0..199
+        assert float(m.compute()) == pytest.approx(99.0, abs=4.0)
+        m.advance()  # evicts 0..99
+        m.update(jnp.arange(200.0, 300.0))
+        assert float(m.compute()) == pytest.approx(199.0, abs=4.0)
+
+    def test_reset_clears_window(self):
+        m = WindowedMetric(MeanMetric(), window_size=2)
+        m.update(3.0)
+        m.advance()
+        m.reset()
+        np.testing.assert_array_equal(m.window_counts(), [0, 0])
+        m.update(4.0)
+        assert float(m.compute()) == pytest.approx(4.0)
+
+    def test_empty_window_compute(self):
+        m = WindowedMetric(SumMetric(), window_size=2)
+        assert float(m.compute()) == 0.0
+
+    def test_validates_base(self):
+        with pytest.raises(MetricsTPUUserError):
+            WindowedMetric(MeanMetric(), window_size=0)
+        with pytest.raises(MetricsTPUUserError):
+            WindowedMetric("mean", window_size=2)
+        with pytest.raises(MetricsTPUUserError):
+            WindowedMetric(CatMetric(), window_size=2)  # list states can't window
+
+    def test_zero_recompiles_across_advances(self):
+        m = WindowedMetric(MeanMetric(), window_size=4, lazy_updates=0)
+        x = jnp.asarray(2.0)
+        # warmup: one update trace + the advance/compute paths
+        m.update(x)
+        m.advance()
+        m.update(x)
+        warm = _trace_total()
+        for i in range(12):
+            m.update(jnp.asarray(float(i)))
+            if i % 3 == 2:
+                m.advance()
+        assert _trace_total() == warm  # advancing must not retrace updates
+
+
+class TestTimeDecayedMetric:
+    def test_matches_exact_ema(self):
+        half_life = 4.0
+        m = TimeDecayedMetric(MeanMetric(), half_life=half_life)
+        values = [1.0, 5.0, 2.0, 8.0, 3.0]
+        for v in values:
+            m.update(v)
+        d = 0.5 ** (1.0 / half_life)
+        num = den = 0.0
+        for v in values:
+            num = num * d + v
+            den = den * d + 1.0
+        assert float(m.compute()) == pytest.approx(num / den, rel=1e-6)
+
+    def test_recent_values_dominate(self):
+        m = TimeDecayedMetric(MeanMetric(), half_life=2.0)
+        for _ in range(10):
+            m.update(0.0)
+        for _ in range(10):
+            m.update(10.0)
+        assert float(m.compute()) > 9.0
+
+    def test_validates_args(self):
+        with pytest.raises(MetricsTPUUserError):
+            TimeDecayedMetric(MeanMetric(), half_life=0.0)
+        with pytest.raises(MetricsTPUUserError):
+            TimeDecayedMetric("mean", half_life=2.0)
+
+
+class TestTrackerIntegration:
+    def test_tracker_snapshots_window_buckets(self):
+        """increment() must carry the sliding window forward, not clobber it."""
+        tr = MetricTracker(WindowedMetric(MeanMetric(), window_size=2), maximize=True)
+        tr.increment()
+        tr.update(2.0)
+        tr[-1].advance()
+        tr.update(4.0)
+        assert float(tr.compute()) == pytest.approx(3.0)
+        tr.increment()  # new step must still see buckets [2.0], [4.0]
+        tr[-1].advance()  # evicts the 2.0 bucket
+        tr.update(6.0)
+        assert float(tr.compute()) == pytest.approx(5.0)
+        # the earlier step's window is untouched by the new step's updates
+        assert float(tr[0].compute()) == pytest.approx(3.0)
+        assert float(tr.best_metric()) == pytest.approx(5.0)
+
+    def test_tracker_plain_metric_still_fresh_per_step(self):
+        tr = MetricTracker(MeanMetric(), maximize=True)
+        tr.increment()
+        tr.update(1.0)
+        tr.increment()
+        tr.update(9.0)
+        np.testing.assert_allclose(np.asarray(tr.compute_all()), [1.0, 9.0])
+
+
+class TestCollectionIntegration:
+    def test_advance_windows_rotates_members(self):
+        col = MetricCollection(
+            {
+                "win": WindowedMetric(MeanMetric(), window_size=2),
+                "acc": Accuracy(num_classes=2, validate_args=False),
+            }
+        )
+        col["win"].update(2.0)
+        evicted = col.advance_windows()
+        assert evicted == {"win": 0}
+        col["win"].update(4.0)
+        assert float(col["win"].compute()) == pytest.approx(3.0)
+        evicted = col.advance_windows()  # evicts the 2.0 bucket
+        assert evicted == {"win": 1}
+        col["win"].update(6.0)
+        assert float(col["win"].compute()) == pytest.approx(5.0)

@@ -1,0 +1,51 @@
+"""Tier-1 gate: streaming/ state code never uses data-dependent shapes."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from shape_lint import lint, lint_source  # noqa: E402
+
+
+def test_streaming_modules_are_shape_static():
+    assert lint() == []
+
+
+def test_lint_source_flags_dynamic_shapes():
+    src = "\n".join(
+        [
+            "import jax.numpy as jnp",
+            "def bad(x):",
+            "    idx = jnp.nonzero(x)",
+            "    uniq = jnp.unique(x)",
+            "    picked = jnp.where(x > 0)",
+            "    n = x.sum().item()",
+            "    return idx, uniq, picked, n",
+            "class BadMetric:",
+            "    def __init__(self):",
+            "        self.add_state('vals', [], fx='cat')",
+            "        self.add_buffer_state('rows', 16)",
+        ]
+    )
+    problems = lint_source(src, "synthetic.py")
+    flagged = "\n".join(problems)
+    assert "nonzero" in flagged
+    assert "unique" in flagged
+    assert "single-argument `where`" in flagged
+    assert ".item()" in flagged
+    assert "list-state default" in flagged
+    assert "buffer states grow" in flagged
+    assert len(problems) == 6
+
+
+def test_lint_source_allows_static_idioms():
+    src = "\n".join(
+        [
+            "import jax.numpy as jnp",
+            "def good(x):",
+            "    masked = jnp.where(x > 0, x, 0.0)",
+            "    return masked.sum()",
+        ]
+    )
+    assert lint_source(src, "synthetic.py") == []

@@ -25,6 +25,7 @@ DOMAINS = [
     ("detection", "Detection"),
     ("wrappers", "Wrappers"),
     ("aggregation", "Aggregation"),
+    ("streaming", "Streaming"),
 ]
 
 OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "api")

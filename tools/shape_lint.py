@@ -1,0 +1,135 @@
+"""Static check: ``metrics_tpu/streaming/`` never uses data-dependent shapes.
+
+The streaming subsystem's whole contract is fixed-shape state: a jitted
+``update`` must never recompile as the stream grows, sketch states must pack
+into fixed-size sync blobs, and ring buffers must rotate in place.  One
+stray ``jnp.nonzero`` / ``.item()`` / boolean-mask extraction silently
+breaks that — it traces fine in eager tests and then either crashes under
+jit or, worse, forces a retrace per batch.
+
+This linter AST-walks every module under ``metrics_tpu/streaming/`` and
+flags:
+
+* calls producing data-dependent output shapes: ``nonzero``,
+  ``flatnonzero``, ``argwhere``, ``unique``, ``extract``, ``compress``,
+  ``repeat`` with array counts is out of scope (numpy-host only), and
+  single-argument ``where`` (the three-argument form is shape-static);
+* host round-trips inside state math: ``.item()`` / ``.tolist()`` on
+  computed values;
+* growing state kinds: any ``add_buffer_state`` call, or ``add_state`` with
+  a ``[]`` (list-state) default.
+
+Run directly (``python tools/shape_lint.py``) or via
+``tests/test_shape_lint.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+STREAMING_DIR = os.path.join(_REPO_ROOT, "metrics_tpu", "streaming")
+
+# call names whose result shape depends on data values
+DYNAMIC_SHAPE_CALLS = {
+    "nonzero",
+    "flatnonzero",
+    "argwhere",
+    "unique",
+    "unique_values",
+    "extract",
+    "compress",
+    "setdiff1d",
+    "union1d",
+    "intersect1d",
+}
+
+# host-pull methods that would put a device sync inside state math
+HOST_PULL_CALLS = {"item", "tolist"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def lint_source(src: str, filename: str) -> List[str]:
+    """Lint one module's source; returns violation strings."""
+    problems: List[str] = []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as err:
+        return [f"{filename}:{err.lineno}: does not parse: {err.msg}"]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        where = f"{filename}:{node.lineno}"
+        if name in DYNAMIC_SHAPE_CALLS:
+            problems.append(
+                f"{where}: `{name}` produces a data-dependent shape; streaming "
+                "state must stay fixed-shape (mask with 3-arg `where` instead)"
+            )
+        elif name == "where" and len(node.args) == 1 and not node.keywords:
+            problems.append(
+                f"{where}: single-argument `where` is data-dependent "
+                "(returns indices); use the 3-argument select form"
+            )
+        elif name in HOST_PULL_CALLS and isinstance(node.func, ast.Attribute):
+            problems.append(
+                f"{where}: `.{name}()` forces a host round-trip inside "
+                "streaming code; keep state math on device"
+            )
+        elif name == "add_buffer_state":
+            problems.append(
+                f"{where}: buffer states grow with the stream; streaming "
+                "metrics must use fixed-shape tensor or sketch states"
+            )
+        elif name == "add_state" and any(
+            isinstance(a, ast.List) and not a.elts for a in node.args
+        ):
+            problems.append(
+                f"{where}: list-state default `[]` grows with the stream; "
+                "streaming metrics must use fixed-shape tensor or sketch states"
+            )
+    return problems
+
+
+def lint() -> List[str]:
+    """Lint every module under metrics_tpu/streaming/."""
+    problems: List[str] = []
+    for base, _dirs, files in sorted(os.walk(STREAMING_DIR)):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(base, fname)
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            rel = os.path.relpath(path, _REPO_ROOT)
+            problems.extend(lint_source(src, rel))
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for line in problems:
+        print(f"shape_lint: {line}", file=sys.stderr)
+    if problems:
+        print(f"shape_lint: {len(problems)} violation(s)", file=sys.stderr)
+        return 1
+    print("shape_lint: streaming/ state is shape-static")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
