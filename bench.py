@@ -906,6 +906,72 @@ def _bench_streaming(n_batches=512, batch=8192, window=8):
     return (n_batches * batch) / t, profile
 
 
+def _bench_checkpoint(n_rows=1_000_000, chunk=65536, saves=5):
+    """Config 7: checkpoint subsystem — save/restore a fat mixed collection.
+
+    Prices the preemption-safety tax: a ``CatMetric`` holding ``n_rows``
+    float32 rows (the worst case — state bytes scale with the stream) plus a
+    constant-state ``StreamingQuantile``, snapshotted ``saves`` times through
+    the atomic tmp-fsync-rename path with per-state digests, then restored
+    once with full digest verification.  Reported rate is checkpoint MB/s
+    (write side); the restore time and the ``ckpt.*`` counter deltas ride the
+    profile so the compact line carries them as ``config7_checkpoint_*``
+    scalars.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import CatMetric, MetricCollection, StreamingQuantile
+    from metrics_tpu.checkpoint import CheckpointManager
+    from metrics_tpu.obs import counters_snapshot
+
+    data = jax.random.normal(jax.random.PRNGKey(7), (n_rows // chunk, chunk), jnp.float32)
+    float(data[0, 0])
+    col = MetricCollection({"cat": CatMetric(), "q": StreamingQuantile(q=(0.5, 0.99))})
+    for i in range(data.shape[0]):
+        col["cat"].update(data[i])
+        col["q"].update(data[i])
+
+    root = tempfile.mkdtemp(prefix="mtpu_bench_ckpt_")
+    before = counters_snapshot()
+    try:
+        mgr = CheckpointManager(root, keep_last=2, rank=0, world_size=1)
+        t0 = time.perf_counter()
+        for s in range(saves):
+            mgr.save(col, step=s)
+        t_save = time.perf_counter() - t0
+
+        col2 = MetricCollection({"cat": CatMetric(), "q": StreamingQuantile(q=(0.5, 0.99))})
+        t0 = time.perf_counter()
+        mgr.restore(col2)
+        t_restore = time.perf_counter() - t0
+        assert int(col2["cat"]._update_count) == data.shape[0]
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    delta = {
+        k: v - before.get(k, 0)
+        for k, v in counters_snapshot().items()
+        if v != before.get(k, 0)
+    }
+    ckpt_counters = {}
+    for (cname, _labels), v in delta.items():
+        if cname.startswith("ckpt."):
+            field = cname[len("ckpt."):]
+            ckpt_counters[field] = ckpt_counters.get(field, 0) + int(v)
+    bytes_written = ckpt_counters.get("bytes_written", 0)
+    profile = {
+        "ckpt_counters": ckpt_counters,
+        "save_secs": round(t_save, 4),
+        "restore_secs": round(t_restore, 4),
+        "state_mb": round(n_rows * 4 / 1e6, 1),
+        "saves": saves,
+    }
+    return (bytes_written / 1e6) / t_save, profile
+
+
 def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -1011,6 +1077,7 @@ def main() -> None:
         ("config5_map_coco_scale_images_per_sec", _bench_map_coco_scale),
         ("config5_map_segm_scale_images_per_sec", _bench_map_segm_scale),
         ("config6_streaming_samples_per_sec", _bench_streaming),
+        ("config7_checkpoint_write_mb_per_sec", _bench_checkpoint),
         ("device_mfu", _bench_mfu),
     ):
         obs_before = _obs_counters()
@@ -1045,6 +1112,15 @@ def main() -> None:
                 for key, val in (result[1].get("streaming_counters") or {}).items():
                     extra[f"config6_streaming_{key}"] = val
                 extra["config6_streaming_timed_recompiles"] = result[1]["timed_recompiles"]
+            elif name.startswith("config7_checkpoint"):
+                extra[name] = round(result[0], 1)
+                extra["config7_checkpoint_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) still carries the checkpoint telemetry
+                for key, val in (result[1].get("ckpt_counters") or {}).items():
+                    extra[f"config7_checkpoint_{key}"] = val
+                extra["config7_checkpoint_save_secs"] = result[1]["save_secs"]
+                extra["config7_checkpoint_restore_secs"] = result[1]["restore_secs"]
             elif name == "device_mfu":
                 extra[name] = result
             else:

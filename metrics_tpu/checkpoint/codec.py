@@ -1,0 +1,269 @@
+"""Checkpoint codec: metric state <-> integrity-checked packed blobs.
+
+Every state kind serializes through the SAME byte codec the delta-sync
+packed transport uses (:func:`metrics_tpu.metric._pack_state_blob`): a
+self-describing container of named numpy arrays that round-trips bf16 and
+0-d shapes.  The checkpoint layer nests it twice:
+
+* per *logical state* (tensor / list / buffer / sketch): the state's flat
+  ``state_pytree`` keys packed into one blob, digested with blake2b — the
+  unit of corruption detection and of the ``skip_state`` restore policy;
+* per *metric*: the state blobs packed into one outer blob (each inner blob
+  is just a uint8 array to the container) — the unit a rank shard file holds
+  for every metric in the checkpoint target.
+
+``_DeltaCache`` contents are deliberately NOT serialized: gathered prefixes
+describe a fleet agreement that dies with the incarnation that negotiated
+it.  ``load_state_pytree``/``merge_state`` clear the cache on restore, so a
+restored metric re-verifies itself through one full gather (delta re-arms on
+the following sync).
+
+``SERIALIZERS`` is the kind registry ``tools/ckpt_lint.py`` statically
+checks against :meth:`Metric.state_kinds` and the ``add_*_state``
+registration surface — a new state kind cannot land without a checkpoint
+path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric, _pack_state_blob, _unpack_state_blob
+
+FORMAT_VERSION = 1
+DIGEST_BYTES = 16
+
+# The metric-level bookkeeping that is not a registered state rides in a
+# reserved pseudo-state ("__meta__" cannot collide: state names must be
+# python identifiers, so they never start with an underscore-underscore mix
+# that the registration APIs would reject anyway).
+META_STATE = "__meta__"
+META_UPDATE_COUNT = "_update_count"
+
+# Which Metric state-registration API produces which codec kind(s) —
+# the static contract ckpt_lint enforces: every ``add*_state`` method on
+# Metric must appear here, and every kind named here must have a serializer.
+STATE_KIND_REGISTRARS: Dict[str, Tuple[str, ...]] = {
+    "add_state": ("tensor", "list"),
+    "add_buffer_state": ("buffer",),
+    "add_sketch_state": ("sketch",),
+}
+
+
+class _KindSerializer(NamedTuple):
+    """How one state kind maps to/from checkpoint arrays.
+
+    ``to_arrays(metric, tree, name)`` pulls the state's arrays out of a
+    ``state_pytree`` snapshot; ``to_pytree(metric, name, arrays, out)``
+    writes restored arrays back into a pytree ``load_state_pytree`` accepts;
+    ``to_merge(metric, name, arrays, out)`` writes them into a state dict
+    ``merge_state`` accepts (list states re-wrapped as lists).
+    """
+
+    to_arrays: Callable[[Metric, Dict[str, Any], str], Dict[str, np.ndarray]]
+    to_pytree: Callable[[Metric, str, Dict[str, np.ndarray], Dict[str, Any]], None]
+    to_merge: Callable[[Metric, str, Dict[str, np.ndarray], Dict[str, Any]], None]
+
+
+def _plain_to_arrays(metric: Metric, tree: Dict[str, Any], name: str) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for key in metric.state_keys(name):
+        value = tree.get(key)
+        if isinstance(value, list):
+            continue  # empty list state: zero rows, nothing to pack
+        out[key] = np.asarray(value)
+    return out
+
+
+def _plain_to_pytree(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    # load_state_pytree wraps a bare array back into [array] for list states
+    out.update(arrays)
+
+
+def _tensor_to_merge(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    for key, value in arrays.items():
+        out[key] = jnp.asarray(value)
+
+
+def _list_to_merge(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    # merge_state extends list states element-wise; a checkpointed list state
+    # is one pre-concatenated chunk
+    out[name] = [jnp.asarray(arrays[name])] if name in arrays else []
+
+
+def _buffer_to_merge(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    bkey, lkey = name + "__buf", name + "__len"
+    if bkey in arrays:
+        out[bkey] = jnp.asarray(arrays[bkey])
+        out[lkey] = int(np.asarray(arrays[lkey]))
+    else:  # state was skipped: contribute the empty placeholder
+        out[bkey] = jnp.zeros((0,), jnp.float32)
+        out[lkey] = 0
+
+
+def _sketch_to_merge(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    for key, value in arrays.items():
+        out[key] = jnp.asarray(value)
+
+
+def _meta_to_arrays(metric: Metric, tree: Dict[str, Any], name: str) -> Dict[str, np.ndarray]:
+    out = {META_UPDATE_COUNT: np.asarray(int(tree.get(META_UPDATE_COUNT, 0)), np.int64)}
+    extra = metric._ckpt_extra_state()
+    if extra:
+        out["extra"] = np.frombuffer(
+            json.dumps(extra, sort_keys=True).encode(), np.uint8
+        )
+    return out
+
+
+def _meta_to_pytree(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    out[META_UPDATE_COUNT] = int(np.asarray(arrays.get(META_UPDATE_COUNT, 0)))
+    extra = arrays.get("extra")
+    if extra is not None:
+        # runtime-determined python attrs (e.g. classification `mode`) go
+        # straight onto the metric: load_state_pytree only moves arrays
+        metric._ckpt_load_extra_state(
+            json.loads(np.asarray(extra, np.uint8).tobytes().decode())
+        )
+
+
+def _meta_to_merge(
+    metric: Metric, name: str, arrays: Dict[str, np.ndarray], out: Dict[str, Any]
+) -> None:
+    pass  # update counts merge through merge_state's other_count argument
+
+
+SERIALIZERS: Dict[str, _KindSerializer] = {
+    "tensor": _KindSerializer(_plain_to_arrays, _plain_to_pytree, _tensor_to_merge),
+    "list": _KindSerializer(_plain_to_arrays, _plain_to_pytree, _list_to_merge),
+    "buffer": _KindSerializer(_plain_to_arrays, _plain_to_pytree, _buffer_to_merge),
+    "sketch": _KindSerializer(_plain_to_arrays, _plain_to_pytree, _sketch_to_merge),
+    META_STATE: _KindSerializer(_meta_to_arrays, _meta_to_pytree, _meta_to_merge),
+}
+
+
+def state_digest(blob: bytes) -> str:
+    return hashlib.blake2b(blob, digest_size=DIGEST_BYTES).hexdigest()
+
+
+class EncodedMetric(NamedTuple):
+    blob: bytes  # outer container: {state_name: inner blob as uint8}
+    digests: Dict[str, str]  # state_name -> blake2b of the inner blob
+    kinds: Dict[str, str]  # state_name -> codec kind
+    update_count: int
+    sync_round: int
+
+
+def encode_metric(metric: Metric) -> EncodedMetric:
+    """Snapshot one metric into an integrity-checked packed blob."""
+    tree = metric.state_pytree()  # flushes lazy/host buffers, trims buffers
+    kinds = dict(metric.state_kinds())
+    kinds[META_STATE] = META_STATE
+    state_blobs: Dict[str, bytes] = {}
+    for sname, kind in kinds.items():
+        arrays = SERIALIZERS[kind].to_arrays(metric, tree, sname)
+        state_blobs[sname] = _pack_state_blob(arrays)
+    digests = {sname: state_digest(b) for sname, b in state_blobs.items()}
+    blob = _pack_state_blob(
+        {sname: np.frombuffer(b, np.uint8) for sname, b in state_blobs.items()}
+    )
+    return EncodedMetric(
+        blob=blob,
+        digests=digests,
+        kinds=kinds,
+        update_count=int(metric._update_count),
+        sync_round=int(metric._delta_cache.round),
+    )
+
+
+class DecodedState(NamedTuple):
+    arrays: Dict[str, Dict[str, np.ndarray]]  # state_name -> flat arrays
+    failed: List[str]  # state names whose digest did not match
+
+
+def decode_metric(blob: bytes, expected_digests: Dict[str, str]) -> DecodedState:
+    """Unpack one metric blob, verifying each state against the manifest.
+
+    A state whose recomputed digest differs from the manifest's — or whose
+    inner blob fails to parse at all — lands in ``failed`` instead of
+    ``arrays``; the caller applies the ``on_restore_error`` policy.  States
+    present in the manifest but absent from the blob are failed too (a torn
+    container), as are unexpected extras (stale container).
+    """
+    arrays: Dict[str, Dict[str, np.ndarray]] = {}
+    failed: List[str] = []
+    try:
+        outer = _unpack_state_blob(blob)
+    except Exception:
+        return DecodedState(arrays={}, failed=sorted(expected_digests))
+    for sname, expect in expected_digests.items():
+        packed = outer.get(sname)
+        if packed is None:
+            failed.append(sname)
+            continue
+        raw = np.asarray(packed, np.uint8).tobytes()
+        if state_digest(raw) != expect:
+            failed.append(sname)
+            continue
+        try:
+            arrays[sname] = _unpack_state_blob(raw)
+        except Exception:
+            failed.append(sname)
+    return DecodedState(arrays=arrays, failed=failed)
+
+
+def arrays_to_pytree(metric: Metric, states: Dict[str, Dict[str, np.ndarray]]) -> Dict[str, Any]:
+    """Assemble decoded per-state arrays into a ``load_state_pytree`` tree."""
+    kinds = dict(metric.state_kinds())
+    kinds[META_STATE] = META_STATE
+    tree: Dict[str, Any] = {}
+    for sname, arrays in states.items():
+        kind = kinds.get(sname)
+        if kind is None:
+            continue  # state no longer registered on this metric class
+        SERIALIZERS[kind].to_pytree(metric, sname, arrays, tree)
+    return tree
+
+
+def arrays_to_merge_state(
+    metric: Metric, states: Dict[str, Dict[str, np.ndarray]]
+) -> Dict[str, Any]:
+    """Assemble decoded per-state arrays into a ``merge_state`` pytree.
+
+    States missing from ``states`` (failed digests under ``skip_state``, or
+    a schema that grew since the checkpoint) contribute their defaults, so
+    the multi-way merge still sees every key it iterates.
+    """
+    kinds = metric.state_kinds()
+    out: Dict[str, Any] = {}
+    for sname, kind in kinds.items():
+        arrays = states.get(sname)
+        if arrays is None:
+            arrays = {}
+            if kind == "tensor":
+                # identity default for the state's reduce: its registered default
+                out[sname] = jnp.array(metric._defaults[sname], copy=True)
+                continue
+            if kind == "sketch":
+                for key in metric.state_keys(sname):
+                    out[key] = jnp.array(metric._defaults[key], copy=True)
+                continue
+        SERIALIZERS[kind].to_merge(metric, sname, arrays, out)
+    return out

@@ -55,6 +55,9 @@ class AUROC(Metric):
         self.add_buffer_state("preds")
         self.add_buffer_state("target")
 
+    # the data-determined mode must survive a checkpoint restore
+    _ckpt_attrs = ("mode",)
+
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)
         self._buffer_append("preds", preds)

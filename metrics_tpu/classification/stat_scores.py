@@ -103,6 +103,10 @@ class StatScores(Metric):
         for s in ("tp", "fp", "tn", "fn"):
             self.add_state(s, default=default_factory(), dist_reduce_fx=reduce_fn)
 
+    # the locked input case must survive a checkpoint restore: a restored
+    # metric may go straight to compute() without seeing another batch
+    _ckpt_attrs = ("mode",)
+
     @staticmethod
     def _input_fingerprint(preds: Array, target: Array) -> tuple:
         """Static (value-free) input signature: enough to notice a mode switch

@@ -71,7 +71,14 @@ def signal_distortion_ratio(
     target = target / jnp.maximum(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-6)
     preds = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), 1e-6)
 
-    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+    # A filter with more taps than the signal has samples over-parameterizes
+    # the least-squares fit: the distortion filter reproduces preds exactly,
+    # the normal equations turn singular, and coh -> 1 blows up the dB ratio
+    # (inf/nan, batch and single solves diverging).  Cap the taps at the
+    # signal length so the system stays positive definite.
+    corr_len = min(filter_length, target.shape[-1])
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=corr_len)
     if load_diag is not None:
         r_0 = r_0.at[..., 0].add(load_diag)
     r = _symmetric_toeplitz(r_0)

@@ -132,6 +132,17 @@ class _LpipsBackbone(nn.Module):
         return total
 
 
+def _clamp_head_weights(variables: dict) -> dict:
+    """Clamp the ``lin{stage}`` 1x1 head kernels to ``>= 0`` (LPIPS validity)."""
+    import jax.tree_util as jtu
+
+    params = dict(variables["params"])
+    for name, leaf in params.items():
+        if name.startswith("lin"):
+            params[name] = jtu.tree_map(lambda k: jnp.maximum(k, 0.0), leaf)
+    return {**variables, "params": params}
+
+
 class LearnedPerceptualImagePatchSimilarity(ChunkedExtractorMixin, Metric):
     """Streaming LPIPS with scalar sum/total states (reference ``lpip.py:118-119``).
 
@@ -190,6 +201,12 @@ class LearnedPerceptualImagePatchSimilarity(ChunkedExtractorMixin, Metric):
                 )
             else:
                 variables = {"params": lpips_params}
+            # LPIPS distances are sums of head-weighted squared diffs, which
+            # is only a valid (non-negative) metric when the 1x1 head kernels
+            # are non-negative — the lpips package enforces w >= 0 during
+            # training (clamp_weights), so this is a no-op for converted
+            # weights but essential for the random-init fallback
+            variables = _clamp_head_weights(variables)
             # variables as jit argument, not closure — closure-captured
             # weights lower as embedded HLO constants and stall compilation
             self._variables = variables

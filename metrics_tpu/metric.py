@@ -2425,6 +2425,70 @@ class Metric(ABC):
             if bname + "__buf" in state_dict:
                 self._refresh_buffer_meta(bname)
 
+    # python attributes determined at runtime from the data (e.g. the
+    # classification input `mode` locked on the first update) that a
+    # checkpoint restore must bring back for compute() to work; values must
+    # be JSON-serializable or EnumStr members
+    _ckpt_attrs: Tuple[str, ...] = ()
+
+    def _ckpt_extra_state(self) -> Dict[str, Any]:
+        """JSON-serializable non-state attrs to ride along in a checkpoint."""
+        from enum import Enum
+
+        out: Dict[str, Any] = {}
+        for attr in self._ckpt_attrs:
+            value = getattr(self, attr, None)
+            if isinstance(value, Enum):
+                value = {"__enum__": type(value).__name__, "value": value.value}
+            out[attr] = value
+        return out
+
+    def _ckpt_load_extra_state(self, extra: Dict[str, Any]) -> None:
+        for attr, value in extra.items():
+            if attr not in self._ckpt_attrs:
+                continue  # checkpoint from an older schema
+            if isinstance(value, dict) and "__enum__" in value:
+                from metrics_tpu.utils import enums as _enums
+
+                enum_cls = getattr(_enums, value["__enum__"], None)
+                value = enum_cls(value["value"]) if enum_cls is not None else value["value"]
+            setattr(self, attr, value)
+
+    def state_kinds(self) -> Dict[str, str]:
+        """Map each *logical* state name to its registered kind.
+
+        Kinds: ``"tensor"`` (fixed-shape array), ``"list"`` (cat-semantics
+        Python list), ``"buffer"`` (padded device buffer + row count, one
+        entry covering both ``<name>__buf`` and ``<name>__len``), and
+        ``"sketch"`` (mergeable fixed-shape pytree, one entry covering every
+        ``<name>__sk_<leaf>`` key).  This is the kind registry the checkpoint
+        codec serializes by — ``tools/ckpt_lint.py`` checks the two stay in
+        lockstep.
+        """
+        out: Dict[str, str] = {}
+        covered: set = set()
+        for name in self._sketch_states:
+            out[name] = "sketch"
+            covered.update(self._sketch_leaf_keys(name))
+        for name in self._buffer_states:
+            out[name] = "buffer"
+            covered.update((name + "__buf", name + "__len"))
+        for name, default in self._defaults.items():
+            if name in covered:
+                continue
+            out[name] = "list" if isinstance(default, list) else "tensor"
+        return out
+
+    def state_keys(self, name: str) -> List[str]:
+        """The flat ``state_pytree`` keys that make up logical state ``name``."""
+        if name in self._sketch_states:
+            return self._sketch_leaf_keys(name)
+        if name in self._buffer_states:
+            return [name + "__buf", name + "__len"]
+        if name in self._defaults:
+            return [name]
+        raise KeyError(f"unknown state {name!r}")
+
     def state_pytree(self) -> Dict[str, Any]:
         """Full state as an orbax-serializable pytree (list states pre-concatenated,
         buffer states trimmed to their valid rows)."""

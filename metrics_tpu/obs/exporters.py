@@ -186,6 +186,7 @@ def summarize_counters(
     by_metric: Dict[str, float] = {}
     sync: Dict[str, float] = {}
     streaming: Dict[str, float] = {}
+    ckpt: Dict[str, float] = {}
     iou_hits = iou_misses = 0.0
     fallbacks = 0.0
     faults = 0.0
@@ -203,6 +204,9 @@ def summarize_counters(
         elif name.startswith("streaming."):
             field = name[len("streaming."):]
             streaming[field] = streaming.get(field, 0) + value
+        elif name.startswith("ckpt."):
+            field = name[len("ckpt."):]
+            ckpt[field] = ckpt.get(field, 0) + value
         elif name == "iou_cache.hits":
             iou_hits += value
         elif name == "iou_cache.misses":
@@ -223,6 +227,8 @@ def summarize_counters(
         }
     if streaming:
         out["streaming"] = {k: int(v) for k, v in sorted(streaming.items())}
+    if ckpt:
+        out["ckpt"] = {k: int(v) for k, v in sorted(ckpt.items())}
     if iou_hits or iou_misses:
         out["iou_cache"] = {
             "hits": int(iou_hits),

@@ -15,13 +15,14 @@ from metrics_tpu.parallel.backend import (
     reduce_synced_state,
     schema_digest_rows,
 )
-from metrics_tpu.parallel.faults import ChaosBackend, ChaosInjectedError
+from metrics_tpu.parallel.faults import ChaosBackend, ChaosInjectedError, ChaosInjectedSyncError
 
 __all__ = [
     "AxisBackend",
     "Backend",
     "ChaosBackend",
     "ChaosInjectedError",
+    "ChaosInjectedSyncError",
     "LoopbackBackend",
     "MultihostBackend",
     "NullBackend",

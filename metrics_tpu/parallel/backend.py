@@ -219,6 +219,24 @@ def guarded_collective(
     )
 
 
+def _kv_get_bytes(client: Any, key: str, timeout_ms: int) -> bytes:
+    """Fetch a coordination-service key, tolerating a not-yet-published peer.
+
+    jax 0.4.37's ``blocking_key_value_get_bytes`` segfaults on its wakeup
+    path when the key arrives after a genuine wait (it only survives the
+    already-present fast path), so waiting is done here: short non-blocking
+    probes with a Python-side deadline.
+    """
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    while True:
+        try:
+            return client.blocking_key_value_get_bytes(key, 50)
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
+
+
 def schema_digest_rows(entries: Sequence[Tuple[str, str]]) -> np.ndarray:
     """Fixed-size per-state digests of ``(name, signature)`` pairs.
 
@@ -546,9 +564,7 @@ class MultihostBackend(Backend):
         backstop_ms = int(1000 * (self.options.timeout * 4 if self.options.timeout else 600.0))
         parts = [
             np.load(
-                io.BytesIO(
-                    client.blocking_key_value_get_bytes(f"mtpu/ag/{seq}/{r}", backstop_ms)
-                ),
+                io.BytesIO(_kv_get_bytes(client, f"mtpu/ag/{seq}/{r}", backstop_ms)),
                 allow_pickle=False,
             )
             for r in range(world)

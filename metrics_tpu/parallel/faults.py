@@ -44,15 +44,27 @@ from metrics_tpu.parallel.backend import (
     guarded_collective,
     schema_digest_rows,
 )
-from metrics_tpu.utils.exceptions import SyncDesyncError
+from metrics_tpu.utils.exceptions import SyncDesyncError, SyncError
 
 FaultSpec = Union[str, Tuple[str, Any]]
 
 _FAULT_KINDS = ("delay", "drop", "corrupt", "error", "desync")
+_FAULT_EXCEPTION_MODES = ("chaos", "sync_error")
 
 
 class ChaosInjectedError(RuntimeError):
     """Transient failure injected by :class:`ChaosBackend` (retryable)."""
+
+
+class ChaosInjectedSyncError(ChaosInjectedError, SyncError):
+    """Injected failure that IS a :class:`SyncError`.
+
+    ``guarded_collective`` propagates ``SyncError`` subclasses immediately
+    (no retry), so this variant flows straight into a metric's
+    ``on_sync_error`` degradation policy — letting chaos schedules exercise
+    ``"use_local" | "skip"`` end-to-end instead of stopping at the retry
+    loop.  Selected with ``ChaosBackend(fault_exception="sync_error")``.
+    """
 
 
 def _nan_poison(value: Any) -> Any:
@@ -103,7 +115,14 @@ class ChaosBackend(Backend):
         drop_secs: float = 60.0,
         options: Optional[SyncOptions] = None,
         packed: Optional[bool] = None,
+        fault_exception: str = "chaos",
     ):
+        if fault_exception not in _FAULT_EXCEPTION_MODES:
+            raise ValueError(
+                f"`fault_exception` must be one of {_FAULT_EXCEPTION_MODES}, "
+                f"got {fault_exception!r}"
+            )
+        self.fault_exception = fault_exception
         self.inner = inner
         # packed sync collapses per-state collectives into one blob gather,
         # which would renumber every existing fault schedule — so the chaos
@@ -169,13 +188,16 @@ class ChaosBackend(Backend):
         def faulted() -> Any:
             # one-shot: the first attempt pays the fault, a retry runs clean
             k, consumed["pending"] = consumed["pending"], None
+            # "sync_error" mode raises a SyncError subclass: the guard
+            # propagates it unretried, straight to the on_sync_error policy
+            exc = ChaosInjectedSyncError if self.fault_exception == "sync_error" else ChaosInjectedError
             if k == "delay":
                 time.sleep(arg if arg is not None else self.delay_secs)
             elif k == "drop":
                 self._drop_event.wait(arg if arg is not None else self.drop_secs)
-                raise ChaosInjectedError(f"collective #{idx} ({op}) dropped by chaos schedule")
+                raise exc(f"collective #{idx} ({op}) dropped by chaos schedule")
             elif k == "error":
-                raise ChaosInjectedError(f"collective #{idx} ({op}) failed by chaos schedule")
+                raise exc(f"collective #{idx} ({op}) failed by chaos schedule")
             out = fn()
             if k == "corrupt":
                 out = _nan_poison(out)

@@ -206,6 +206,82 @@ def _scenario_sketch(rank: int, nproc: int) -> None:
     print(f"DCN_SKETCH_OK rank={rank}", flush=True)
 
 
+def _ckpt_collection():
+    from metrics_tpu import CatMetric, MetricCollection
+    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.streaming import StreamingQuantile
+    from tests.bases.dummies import DummyMetricSum
+
+    return MetricCollection(
+        {
+            "sum": DummyMetricSum(),
+            "cat": CatMetric(),
+            "acc": Accuracy(num_classes=4, validate_args=False),
+            "q": StreamingQuantile(q=(0.1, 0.5, 0.9)),
+        }
+    )
+
+
+def _ckpt_feed(col, rank: int, step: int) -> None:
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5000 + 17 * rank + step)
+    x = jnp.asarray(rng.normal(size=32).astype(np.float32))
+    col["sum"].update(float(step + rank))
+    col["cat"].update(x)
+    col["acc"].update(jnp.asarray(rng.integers(0, 4, 32)), jnp.asarray(rng.integers(0, 4, 32)))
+    col["q"].update(x)
+
+
+def _scenario_ckpt_save(rank: int, nproc: int) -> None:
+    """First life: accumulate three steps, commit a checkpoint, die."""
+    from metrics_tpu.checkpoint import CheckpointManager
+
+    col = _ckpt_collection()
+    for step in range(3):
+        _ckpt_feed(col, rank, step)
+    # rank/world default to jax.process_index()/process_count(): this save
+    # goes through the REAL coordination service (snapshot barrier, rank 0
+    # collecting shard metas, KV commit broadcast to rank 1)
+    mgr = CheckpointManager(os.environ["MTPU_CKPT_DIR"])
+    committed = mgr.save(col, step=0)
+    assert committed == 0, committed
+    print(f"DCN_CKPT_SAVE_OK rank={rank}", flush=True)
+    sys.stdout.flush()
+    # preemption: die without graceful jax.distributed teardown (rendezvous
+    # first so neither rank trips the other's heartbeat watchdog)
+    _sync_exit("ckpt_save_exit")
+
+
+def _scenario_ckpt_restore(rank: int, nproc: int) -> None:
+    """Second life (fresh processes, fresh coordination service): restore,
+    resume, and match the uninterrupted run bit-exactly — synced compute()
+    included, so the restored state also survives a real cross-host sync."""
+    import numpy as np
+
+    from metrics_tpu.checkpoint import CheckpointManager
+
+    col = _ckpt_collection()
+    res = CheckpointManager(os.environ["MTPU_CKPT_DIR"]).restore(col)
+    assert res.step == 0 and res.world_size == nproc, (res.step, res.world_size)
+    assert res.folded_shards == [] and res.missing_shards == [], res
+    assert sorted(res.restored_metrics) == ["col/acc", "col/cat", "col/q", "col/sum"], res
+    for step in range(3, 6):
+        _ckpt_feed(col, rank, step)
+    got = {k: np.asarray(v) for k, v in col.compute().items()}
+
+    ref = _ckpt_collection()  # the run that was never preempted
+    for step in range(6):
+        _ckpt_feed(ref, rank, step)
+    want = {k: np.asarray(v) for k, v in ref.compute().items()}
+    for key in want:
+        np.testing.assert_array_equal(got[key], want[key], err_msg=key)
+    print(f"DCN_CKPT_OK rank={rank}", flush=True)
+    sys.stdout.flush()
+    _sync_exit("ckpt_restore_exit")
+
+
 def main() -> None:
     rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -227,6 +303,12 @@ def main() -> None:
         return
     if scenario == "sketch":
         _scenario_sketch(rank, nproc)
+        return
+    if scenario == "ckpt_save":
+        _scenario_ckpt_save(rank, nproc)
+        return
+    if scenario == "ckpt_restore":
+        _scenario_ckpt_restore(rank, nproc)
         return
     import numpy as np
     import jax.numpy as jnp

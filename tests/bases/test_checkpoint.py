@@ -77,3 +77,116 @@ def test_collection_state_roundtrip():
     np.testing.assert_allclose(
         float(col2.compute()["acc"]), float(col.compute()["acc"])
     )
+
+# ---------------------------------------------------------------------------
+# Persistence round trips for the stateful-structure kinds: sketches and
+# window ring buffers must survive both the state_dict protocol and pickle
+# with bit-exact compute() — and keep accumulating identically afterwards.
+
+
+def _fill_quantile(seed=0, n=6):
+    from metrics_tpu import StreamingQuantile
+
+    m = StreamingQuantile(q=(0.25, 0.5, 0.9))
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        m.update(jnp.asarray(rng.normal(size=64)))
+    return m
+
+
+def _fill_windowed(seed=0):
+    from metrics_tpu import MeanMetric, WindowedMetric
+
+    m = WindowedMetric(MeanMetric(), window_size=4)
+    rng = np.random.default_rng(seed)
+    for _ in range(6):  # wraps the ring: eviction state matters
+        m.update(jnp.asarray(rng.normal(size=8)))
+        m.advance()
+    m.update(jnp.asarray(rng.normal(size=8)))
+    return m
+
+
+def _resume_identically(a, b, feed, steps=3):
+    rng_a, rng_b = np.random.default_rng(99), np.random.default_rng(99)
+    for _ in range(steps):
+        feed(a, rng_a)
+        feed(b, rng_b)
+    np.testing.assert_array_equal(np.asarray(a.compute()), np.asarray(b.compute()))
+
+
+def test_sketch_state_dict_roundtrip_bit_exact():
+    from metrics_tpu import StreamingQuantile
+
+    m = _fill_quantile()
+    m.persistent(True)
+    sd = m.state_dict()
+    assert any("__sk_" in k for k in sd), "sketch leaves missing from state_dict"
+
+    m2 = StreamingQuantile(q=(0.25, 0.5, 0.9))
+    m2.load_state_dict(sd)
+    m2._update_count = m._update_count
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m2.compute()))
+    for key, value in sd.items():  # raw sketch leaves, not just the estimate
+        np.testing.assert_array_equal(np.asarray(value), np.asarray(m2._state[key]), err_msg=key)
+    _resume_identically(m, m2, lambda mm, rng: mm.update(jnp.asarray(rng.normal(size=32))))
+
+
+def test_sketch_pickle_roundtrip_bit_exact():
+    import pickle
+
+    m = _fill_quantile(seed=3)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2._update_count == m._update_count
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m2.compute()))
+    _resume_identically(m, m2, lambda mm, rng: mm.update(jnp.asarray(rng.normal(size=32))))
+
+
+def test_windowed_ring_buffer_state_dict_roundtrip():
+    from metrics_tpu import MeanMetric, WindowedMetric
+
+    m = _fill_windowed()
+    m.persistent(True)
+    sd = m.state_dict()
+    assert "w__ptr" in sd and "w__count" in sd  # the ring geometry is state
+
+    m2 = WindowedMetric(MeanMetric(), window_size=4)
+    m2.load_state_dict(sd)
+    m2._update_count = m._update_count
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m2.compute()))
+    assert list(m.window_counts()) == list(m2.window_counts())
+
+    def feed(mm, rng):
+        mm.advance()
+        mm.update(jnp.asarray(rng.normal(size=8)))
+
+    _resume_identically(m, m2, feed, steps=5)  # > window_size: evictions align
+
+
+def test_windowed_ring_buffer_pickle_roundtrip():
+    import pickle
+
+    m = _fill_windowed(seed=7)
+    m2 = pickle.loads(pickle.dumps(m))
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(m2.compute()))
+    assert list(m.window_counts()) == list(m2.window_counts())
+
+    def feed(mm, rng):
+        mm.advance()
+        mm.update(jnp.asarray(rng.normal(size=8)))
+
+    _resume_identically(m, m2, feed, steps=5)
+
+
+@pytest.mark.slow
+def test_sketch_pickle_preserves_merge_capability():
+    # a restored sketch must still merge (the elastic-restore path):
+    # pickle must not sever the merge_fn plumbing
+    import pickle
+
+    from metrics_tpu import StreamingQuantile
+
+    a, b = _fill_quantile(seed=1), _fill_quantile(seed=2)
+    a2 = pickle.loads(pickle.dumps(a))
+    a.merge_state({k: v for k, v in b.state_pytree().items() if k != "_update_count"}, other_count=b._update_count)
+    a2.merge_state({k: v for k, v in b.state_pytree().items() if k != "_update_count"}, other_count=b._update_count)
+    np.testing.assert_array_equal(np.asarray(a.compute()), np.asarray(a2.compute()))

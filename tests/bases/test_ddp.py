@@ -85,7 +85,7 @@ def test_axis_context_routing():
     assert current_axis() is None
 
 
-def _spawn_dcn_workers(scenario=None, timeout=300):
+def _spawn_dcn_workers(scenario=None, timeout=300, extra_env=None):
     """Spawn the 2-process DCN worker, return ``[(returncode, output), ...]``."""
     import os
     import socket
@@ -103,6 +103,7 @@ def _spawn_dcn_workers(scenario=None, timeout=300):
     env.pop("XLA_FLAGS", None)  # workers need plain 1-device CPU platforms
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     nproc = 2
     argv_tail = [str(port)] + ([scenario] if scenario else [])
     procs = [
@@ -130,6 +131,7 @@ def _spawn_dcn_workers(scenario=None, timeout=300):
     return [(p.returncode, out) for p, (out, _) in zip(procs, outs)]
 
 
+@pytest.mark.slow
 def test_multihost_two_process_real():
     """Real spawned 2-process DCN sync through Metric.compute().
 
@@ -154,6 +156,7 @@ def test_multihost_desynced_peer_fails_fast():
         assert f"DCN_DESYNC_OK rank={r} peer={1 - r} state=vec" in out
 
 
+@pytest.mark.slow
 def test_multihost_stalled_peer_times_out():
     """A peer that never joins the sync must trip rank 0's watchdog within
     its ``sync_timeout`` budget — a ``SyncTimeoutError`` with retry/timeout
@@ -165,6 +168,7 @@ def test_multihost_stalled_peer_times_out():
     assert "DCN_STALL_OK rank=1 role=stalled" in results[1][1]
 
 
+@pytest.mark.slow
 def test_multihost_delta_sync_two_process():
     """Real 2-process incremental sync: round 1 full-gathers, later rounds ship
     only newly appended rows against the cached gathered prefix, values match
@@ -176,6 +180,7 @@ def test_multihost_delta_sync_two_process():
         assert f"DCN_DELTA_OK rank={r}" in out
 
 
+@pytest.mark.slow
 def test_multihost_sketch_merge_two_process():
     """Real 2-process sketch sync: each rank folds a disjoint distribution
     into a ``StreamingQuantile`` KLL sketch; compute must gather and MERGE
@@ -185,6 +190,29 @@ def test_multihost_sketch_merge_two_process():
     for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="sketch", timeout=120)):
         assert code == 0, f"rank {r} failed:\n{out}"
         assert f"DCN_SKETCH_OK rank={r}" in out
+
+
+@pytest.mark.slow
+def test_multihost_checkpoint_save_kill_restore_resume(tmp_path):
+    """Real 2-process preemption drill: first life accumulates and commits a
+    checkpoint through the live coordination service (snapshot barrier, KV
+    commit broadcast), then DIES; a second pair of processes — fresh
+    coordination service, fresh objects — runs the restore quorum, resumes
+    updating, and every metric's synced ``compute()`` is bit-identical to a
+    run that was never preempted."""
+    extra = {"MTPU_CKPT_DIR": str(tmp_path)}
+    for r, (code, out) in enumerate(
+        _spawn_dcn_workers(scenario="ckpt_save", timeout=180, extra_env=extra)
+    ):
+        assert code == 0, f"rank {r} save life failed:\n{out}"
+        assert f"DCN_CKPT_SAVE_OK rank={r}" in out
+    # both save processes are dead; the commit must be durable on disk
+    assert (tmp_path / "step_00000000" / "MANIFEST.json").exists()
+    for r, (code, out) in enumerate(
+        _spawn_dcn_workers(scenario="ckpt_restore", timeout=180, extra_env=extra)
+    ):
+        assert code == 0, f"rank {r} restore life failed:\n{out}"
+        assert f"DCN_CKPT_OK rank={r}" in out
 
 
 def test_multihost_uneven_gather_unit():

@@ -36,6 +36,7 @@ def test_hash_prefix_parsing():
     assert fetch_weights._hash_prefix_from_name("http://x/plain.pth") is None
 
 
+@pytest.mark.slow
 def test_fetch_pipeline_and_discovery(tmp_path, monkeypatch):
     src = tmp_path / "src"
     src.mkdir()

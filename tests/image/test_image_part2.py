@@ -228,7 +228,15 @@ class TestLPIPS:
 
 
 class TestBackboneShapes:
-    @pytest.mark.parametrize("tap,dim", [("64", 64), ("192", 192), ("768", 768), ("2048", 2048)])
+    @pytest.mark.parametrize(
+        "tap,dim",
+        [
+            ("64", 64),
+            ("192", 192),
+            pytest.param("768", 768, marks=pytest.mark.slow),
+            ("2048", 2048),
+        ],
+    )
     def test_inception_taps(self, tap, dim):
         from metrics_tpu.image.backbones.inception import InceptionFeatureExtractor
 

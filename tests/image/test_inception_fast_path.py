@@ -46,6 +46,7 @@ def test_fold_matches_canonical_textbook_variant():
     )
 
 
+@pytest.mark.slow
 def test_extractor_optimized_matches_reference_path():
     imgs = (np.random.default_rng(0).random((3, 3, 64, 64)) * 255).astype(np.uint8)
     base = InceptionFeatureExtractor(feature="2048", optimized=False)

@@ -77,7 +77,9 @@ class TestKLL:
             assert _rank_error(sorted_data, q, est) <= eps, (q, est, eps)
 
     @pytest.mark.parametrize("seed", [0, 3])
-    @pytest.mark.parametrize("shards", [2, 5])
+    @pytest.mark.parametrize(
+        "shards", [2, pytest.param(5, marks=pytest.mark.slow)]
+    )
     def test_merge_property_matches_union(self, seed, shards):
         """Sketch merged across N shards ~ one sketch over the concatenated
         stream: the union's rank-error bound holds for the merged estimate."""

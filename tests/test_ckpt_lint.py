@@ -1,0 +1,16 @@
+"""Tier-1 gate: every registered state kind has a checkpoint serializer."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from ckpt_lint import lint, lint_roundtrip  # noqa: E402
+
+
+def test_every_state_registrar_is_declared_and_serialized():
+    assert lint() == []
+
+
+def test_every_kind_roundtrips_through_the_codec():
+    assert lint_roundtrip() == []

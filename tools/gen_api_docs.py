@@ -26,6 +26,7 @@ DOMAINS = [
     ("wrappers", "Wrappers"),
     ("aggregation", "Aggregation"),
     ("streaming", "Streaming"),
+    ("checkpoint", "Checkpoint"),
 ]
 
 OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "api")
