@@ -972,6 +972,126 @@ def _bench_checkpoint(n_rows=1_000_000, chunk=65536, saves=5):
     return (bytes_written / 1e6) / t_save, profile
 
 
+def _bench_multistream(num_streams=1024, n_batches=32, batch=4096, baseline_streams=48):
+    """Config 8: multistream subsystem — one metric, ``num_streams`` streams.
+
+    Prices the multi-tenant pitch: a per-stream ``Accuracy`` fleet plus a
+    per-stream ``StreamingQuantile`` fleet, each a single
+    ``MultiStreamMetric`` whose jitted scatter update dispatches every batch
+    once regardless of how many streams it touches.  The looped baseline is
+    what users write today — a Python dict of independent metrics, rows
+    grouped on host and fed to each touched metric eagerly
+    (``jit_update=False, lazy_updates=0``; jitting 2x1024 singleton metrics
+    would spend the whole bench compiling).  Per-object eager dispatch costs
+    ~1s and ~10MB of trace arenas per touched stream, so the baseline runs
+    one batch restricted to the first ``baseline_streams`` streams and is
+    rate-normalized per processed row — per-row cost in a dict-of-metrics is
+    flat in ``num_streams``, so the extrapolation favors the baseline if
+    anything.  ``timed_recompiles`` must stay 0: the scatter trace is
+    shape-keyed on the batch, not on ids.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MultiStreamMetric, StreamingQuantile
+    from metrics_tpu.obs import counters_snapshot
+
+    rng = np.random.default_rng(8)
+    preds = jnp.asarray(rng.integers(0, 4, (n_batches, batch)), jnp.int32)
+    target = jnp.asarray(rng.integers(0, 4, (n_batches, batch)), jnp.int32)
+    vals = jnp.asarray(rng.normal(size=(n_batches, batch)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, num_streams, (n_batches, batch)), jnp.int32)
+    jax.block_until_ready((preds, target, vals, ids))
+
+    def make_fleet():
+        acc = MultiStreamMetric(Accuracy(num_classes=4), num_streams=num_streams)
+        q = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=n_batches * batch),
+            num_streams=num_streams,
+            max_rows_per_stream=64,
+        )
+        return acc, q
+
+    def run_fleet(acc, q):
+        acc.reset()
+        q.reset()
+        for i in range(n_batches):
+            acc.update(preds[i], target[i], stream_ids=ids[i])
+            q.update(vals[i], stream_ids=ids[i])
+        out = np.asarray(acc.compute())
+        qv = np.asarray(q.compute())
+        return out, qv
+
+    acc, q = make_fleet()
+    run_fleet(acc, q)  # warm the scatter + vmapped-sketch traces
+    before = counters_snapshot()
+    t = _median_time(lambda: run_fleet(acc, q), repeats=3)
+    delta = {
+        k: v - before.get(k, 0)
+        for k, v in counters_snapshot().items()
+        if v != before.get(k, 0)
+    }
+    ms_counters = {}
+    recompiles = 0
+    for (cname, _labels), v in delta.items():
+        if cname.startswith("multistream."):
+            field = cname[len("multistream."):]
+            ms_counters[field] = ms_counters.get(field, 0) + int(v)
+        elif cname == "jit_traces":
+            recompiles += int(v)
+    fleet_rate = (n_batches * batch) / t
+
+    # looped baseline: one Python metric object per stream, rows grouped on
+    # host — restricted to `baseline_streams` streams of one batch and
+    # rate-normalized per processed row
+    host_ids = np.asarray(ids[0])
+    host_preds = np.asarray(preds[0])
+    host_target = np.asarray(target[0])
+    host_vals = np.asarray(vals[0])
+    order = np.argsort(host_ids, kind="stable")
+    sorted_ids = host_ids[order]
+    starts = np.searchsorted(sorted_ids, np.arange(baseline_streams), side="left")
+    ends = np.searchsorted(sorted_ids, np.arange(baseline_streams), side="right")
+    baseline_rows = int(ends[-1] - starts[0]) if baseline_streams else 0
+
+    def run_baseline():
+        accs = [
+            Accuracy(num_classes=4, jit_update=False, jit_compute=False, lazy_updates=0)
+            for _ in range(baseline_streams)
+        ]
+        qs = [
+            StreamingQuantile(
+                capacity=64,
+                max_items=n_batches * batch,
+                jit_update=False,
+                jit_compute=False,
+                lazy_updates=0,
+            )
+            for _ in range(baseline_streams)
+        ]
+        for s in range(baseline_streams):
+            rows = order[starts[s]:ends[s]]
+            if rows.size == 0:
+                continue
+            accs[s].update(jnp.asarray(host_preds[rows]), jnp.asarray(host_target[rows]))
+            qs[s].update(jnp.asarray(host_vals[rows]))
+        return [float(a.compute()) for a in accs[:4]]
+
+    t_base = _median_time(run_baseline, repeats=1)
+    baseline_rate = baseline_rows / t_base if baseline_rows else 0.0
+
+    profile = {
+        "multistream_counters": ms_counters,
+        # three timed repeats after warmup: any nonzero here means the
+        # scatter/vmap traces are shape-unstable and retracing per batch
+        "timed_recompiles": recompiles,
+        "num_streams": num_streams,
+        "baseline_samples_per_sec": round(baseline_rate, 1),
+        "speedup_vs_looped": round(fleet_rate / baseline_rate, 1) if baseline_rate else None,
+    }
+    return fleet_rate, profile
+
+
 def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -1078,6 +1198,7 @@ def main() -> None:
         ("config5_map_segm_scale_images_per_sec", _bench_map_segm_scale),
         ("config6_streaming_samples_per_sec", _bench_streaming),
         ("config7_checkpoint_write_mb_per_sec", _bench_checkpoint),
+        ("config8_multistream_samples_per_sec", _bench_multistream),
         ("device_mfu", _bench_mfu),
     ):
         obs_before = _obs_counters()
@@ -1121,6 +1242,18 @@ def main() -> None:
                     extra[f"config7_checkpoint_{key}"] = val
                 extra["config7_checkpoint_save_secs"] = result[1]["save_secs"]
                 extra["config7_checkpoint_restore_secs"] = result[1]["restore_secs"]
+            elif name.startswith("config8_multistream"):
+                extra[name] = round(result[0], 1)
+                extra["config8_multistream_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) still carries the multistream telemetry
+                for key, val in (result[1].get("multistream_counters") or {}).items():
+                    extra[f"config8_multistream_{key}"] = val
+                extra["config8_multistream_timed_recompiles"] = result[1]["timed_recompiles"]
+                extra["config8_multistream_speedup_vs_looped"] = result[1]["speedup_vs_looped"]
+                extra["config8_multistream_baseline_samples_per_sec"] = result[1][
+                    "baseline_samples_per_sec"
+                ]
             elif name == "device_mfu":
                 extra[name] = result
             else:

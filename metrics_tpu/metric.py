@@ -2414,6 +2414,7 @@ class Metric(ABC):
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         self._delta_cache.clear()  # loaded rows were never part of a gathered prefix
+        self._computed = None  # cached compute() predates the loaded state
         for name, value in state_dict.items():
             if name not in self._defaults:
                 raise KeyError(f"unknown state {name!r}")
@@ -2489,6 +2490,61 @@ class Metric(ABC):
             return [name]
         raise KeyError(f"unknown state {name!r}")
 
+    def stacked_states(self, num_streams: int) -> List[Dict[str, Any]]:
+        """Registration specs for this metric's states with a leading
+        ``(num_streams, ...)`` stream axis (the multistream/ subsystem's
+        registration hook).
+
+        Returns one spec per *logical* state: ``{"kind": "tensor", "name",
+        "default", "reduce"}`` for tensor states and ``{"kind": "sketch",
+        "name", "tree", "merge"}`` for sketch states, each default/leaf
+        broadcast to ``(num_streams,) + shape``.  PRNG-key leaves (uint32
+        ``(2,)``, e.g. a KLL sketch's compaction key) are not broadcast but
+        folded per-stream with :func:`jax.random.fold_in` so stream
+        compaction coin flips decorrelate.  List and buffer states grow with
+        the stream and have no per-stream stacked form — they raise.
+        """
+        num_streams = int(num_streams)
+        if num_streams < 1:
+            raise ValueError(f"num_streams must be >= 1, got {num_streams}")
+        specs: List[Dict[str, Any]] = []
+        covered: set = set()
+        streams = jnp.arange(num_streams, dtype=jnp.uint32)
+
+        def _stack(leaf: Any) -> Array:
+            leaf = jnp.asarray(leaf)
+            if leaf.dtype == jnp.uint32 and leaf.shape == (2,):
+                # raw PRNG key: per-stream decorrelated fold, not a broadcast
+                return jax.vmap(lambda i: jax.random.fold_in(leaf, i))(streams)
+            return jnp.broadcast_to(leaf, (num_streams,) + leaf.shape)
+
+        for name, meta in self._sketch_states.items():
+            tree = {
+                leaf: _stack(self._defaults[f"{name}__sk_{leaf}"]) for leaf in meta["leaves"]
+            }
+            specs.append({"kind": "sketch", "name": name, "tree": tree, "merge": meta["merge"]})
+            covered.update(self._sketch_leaf_keys(name))
+        buffer_keys = {
+            key for bname in self._buffer_states for key in (bname + "__buf", bname + "__len")
+        }
+        for name, default in self._defaults.items():
+            if name in covered:
+                continue
+            if isinstance(default, list) or name in buffer_keys:
+                raise MetricsTPUUserError(
+                    f"state {name!r} is a list/buffer state; growing states have no "
+                    "fixed-shape per-stream stacked form"
+                )
+            specs.append(
+                {
+                    "kind": "tensor",
+                    "name": name,
+                    "default": _stack(default),
+                    "reduce": self._reduce_fns[name],
+                }
+            )
+        return specs
+
     def state_pytree(self) -> Dict[str, Any]:
         """Full state as an orbax-serializable pytree (list states pre-concatenated,
         buffer states trimmed to their valid rows)."""
@@ -2506,6 +2562,7 @@ class Metric(ABC):
 
     def load_state_pytree(self, tree: Dict[str, Any]) -> None:
         self._delta_cache.clear()  # loaded rows were never part of a gathered prefix
+        self._computed = None  # cached compute() predates the loaded state
         self._update_count = int(tree.pop("_update_count", 0))
         for name, value in tree.items():
             if isinstance(self._defaults.get(name), list) and not isinstance(value, list):

@@ -1,0 +1,27 @@
+"""Multi-tenant metric streams: one Metric, S independent streams.
+
+:class:`MultiStreamMetric` turns any supported metric into a fleet of
+``num_streams`` independent streams backed by a single set of stacked state
+arrays — per-user / per-cohort / per-slice evaluation without a Python
+object per stream.  Updates scatter rows to streams in one compiled
+dispatch, sketch states vmap slot-wise, and the query path
+(``compute_streams`` / ``top_k`` / ``bottom_k`` / ``where``) ranks streams
+on device so only ``k`` rows ever reach the host.  See
+``docs/multistream.md``.
+"""
+
+from metrics_tpu.multistream.core import MultiStreamMetric
+from metrics_tpu.multistream.sharding import (
+    replicate_sharding,
+    shard_streams,
+    stream_mesh,
+    stream_sharding,
+)
+
+__all__ = [
+    "MultiStreamMetric",
+    "shard_streams",
+    "stream_mesh",
+    "stream_sharding",
+    "replicate_sharding",
+]

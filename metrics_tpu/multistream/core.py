@@ -1,0 +1,525 @@
+"""One Metric, S independent streams backed by stacked state arrays.
+
+:class:`MultiStreamMetric` wraps a supported base metric and re-registers
+every base state with a leading ``(num_streams, ...)`` axis (via
+``Metric.stacked_states``).  ``update(..., stream_ids=...)`` routes each
+input row to its stream in ONE compiled dispatch regardless of how many
+streams the batch touches, and ``compute()`` evaluates every stream with one
+vmapped pass.  Two update strategies, picked at construction:
+
+* **segment** — every base state is a fixed-shape tensor with an
+  associative ``sum``/``max``/``min`` reduce and the base declares
+  ``full_state_update = False``.  The base ``update`` runs vmapped per input
+  row from the default state and the per-row states fold into the stacked
+  state with ``jax.ops.segment_sum`` / ``segment_max`` / ``segment_min`` —
+  O(batch + num_streams) work per call.  Accuracy, the error-sum regression
+  metrics, and the aggregation metrics all take this path.
+* **vmap** — the base holds sketch states (StreamingQuantile /
+  StreamingHistogram), whose transition is not a segment reduction.  Rows
+  are bucketed by stream id into a static ``(num_streams,
+  max_rows_per_stream)`` staging block (NaN-padded — sketch updates drop
+  non-finite inputs by contract) and the full base ``update`` runs vmapped
+  over the stream axis — O(num_streams * max_rows_per_stream) work, zero
+  recompiles after warmup.
+
+Because the stacked states are ordinary ``sum``/``max``/``min``/sketch
+states, cross-host sync (including delta preflight and the packed-blob
+transport), ``merge_state`` elastic folding, ``state_dict`` / pickling, and
+the checkpoint codec all apply per-axis unchanged: syncing a stacked sum
+state element-wise-sums the per-stream rows across ranks, and stacked
+sketches merge slot-wise through a vmapped base merge.
+
+The query path never materializes all streams on the host:
+``compute_streams(ids)`` gathers only the requested state rows,
+``top_k``/``bottom_k``/``where`` rank every stream on device with
+``lax.top_k`` and return ``k`` rows.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from metrics_tpu.metric import Metric, _flatten_batched_inputs
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.obs import core as _obs
+
+__all__ = ["MultiStreamMetric"]
+
+Array = jax.Array
+
+_SEGMENT_REDUCES = ("sum", "max", "min")
+
+
+class _VmappedSketchMerge:
+    """Slot-wise merge for a stacked sketch state: vmap the base merge over
+    the leading stream axis.  A module-level class (not a closure) so
+    pickled metrics can reconstruct it."""
+
+    def __init__(self, base_merge: Callable):
+        self.base_merge = base_merge
+
+    def __call__(self, trees: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        trees = [
+            {leaf: jnp.asarray(v) for leaf, v in tree.items()} for tree in trees
+        ]
+        return jax.vmap(lambda *per_stream: self.base_merge(list(per_stream)))(*trees)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, _VmappedSketchMerge) and self.base_merge == other.base_merge
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.base_merge))
+
+
+class MultiStreamMetric(Metric):
+    """Vectorize a base metric over ``num_streams`` independent streams.
+
+    ``update(*args, stream_ids=..., **kwargs)`` takes the base metric's
+    update arguments where every array leaf carries a leading row axis, plus
+    an integer ``stream_ids`` vector assigning each row to a stream.  Rows
+    with ids outside ``[0, num_streams)`` are dropped (counted in the
+    ``stream_dropped`` state).  ``compute()`` returns the base metric's
+    value per stream, stacked on a leading ``(num_streams, ...)`` axis;
+    streams that never received a row compute whatever the base metric
+    yields on default state (typically NaN).
+
+    Args:
+        base: a fresh (never-updated) metric instance to vectorize.  Its
+            states must all be fixed-shape tensor states with
+            ``sum``/``max``/``min`` reduces, or sketch states.  ``sum``
+            states must default to zero (the same identity the cross-rank
+            sum sync already assumes).
+        num_streams: the static stream count S.
+        max_rows_per_stream: static per-stream row capacity per update call
+            on the vmapped (sketch) path; rows beyond it are dropped and
+            counted.  Defaults to ``min(batch, max(8, ceil(4 * batch /
+            num_streams)))`` — generous for uniformly scattered ids.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.multistream import MultiStreamMetric
+        >>> m = MultiStreamMetric(Accuracy(num_classes=2), num_streams=3)
+        >>> m.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 1, 1, 0]),
+        ...          stream_ids=jnp.asarray([0, 0, 2, 2]))
+        >>> [round(float(x), 2) for x in m.compute()[jnp.asarray([0, 2])]]
+        [0.5, 0.5]
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    # reserved (non-base) stacked bookkeeping states
+    _ROWS_STATE = "stream_rows"
+    _DROPPED_STATE = "stream_dropped"
+
+    def __init__(
+        self,
+        base: Metric,
+        num_streams: int,
+        max_rows_per_stream: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base, Metric):
+            raise MetricsTPUUserError(
+                f"MultiStreamMetric wraps a Metric instance, got {type(base).__name__}"
+            )
+        if base.update_count or base._is_synced:
+            raise MetricsTPUUserError(
+                "MultiStreamMetric needs a fresh base metric: the wrapper owns all "
+                "state, and updates already folded into the base cannot be split "
+                "back into streams"
+            )
+        if isinstance(base, MultiStreamMetric):
+            raise MetricsTPUUserError("MultiStreamMetric cannot nest another MultiStreamMetric")
+        self.num_streams = int(num_streams)
+        if self.num_streams < 1:
+            raise ValueError(f"num_streams must be >= 1, got {num_streams}")
+        self.max_rows_per_stream = None if max_rows_per_stream is None else int(max_rows_per_stream)
+        if self.max_rows_per_stream is not None and self.max_rows_per_stream < 1:
+            raise ValueError(f"max_rows_per_stream must be >= 1, got {max_rows_per_stream}")
+        self._base = base
+        # the base never runs its own wrapped update/compute; quiesce its
+        # lazy accumulator so apply_* is its only execution surface
+        base.lazy_updates = 0
+
+        specs = base.stacked_states(self.num_streams)  # rejects list/buffer states
+        self._base_tensor_reduces: Dict[str, Any] = {}
+        self._base_sketch_names: List[str] = []
+        for spec in specs:
+            if spec["name"] in (self._ROWS_STATE, self._DROPPED_STATE):
+                raise MetricsTPUUserError(
+                    f"base state name {spec['name']!r} collides with MultiStreamMetric "
+                    "bookkeeping states"
+                )
+            if spec["kind"] == "sketch":
+                self.add_sketch_state(
+                    spec["name"], spec["tree"], _VmappedSketchMerge(spec["merge"])
+                )
+                self._base_sketch_names.append(spec["name"])
+                continue
+            fx = spec["reduce"]
+            if fx not in _SEGMENT_REDUCES:
+                raise MetricsTPUUserError(
+                    f"base state {spec['name']!r} reduces with {fx!r}; MultiStreamMetric "
+                    f"supports tensor states with reduce in {_SEGMENT_REDUCES} and sketch "
+                    "states"
+                )
+            if fx == "sum" and bool(np.any(np.asarray(spec["default"]))):
+                raise MetricsTPUUserError(
+                    f"sum state {spec['name']!r} has a non-zero default; per-stream "
+                    "scatter (like the cross-rank sum sync) needs the zero identity"
+                )
+            self.add_state(spec["name"], spec["default"], dist_reduce_fx=fx)
+            self._base_tensor_reduces[spec["name"]] = fx
+
+        if self._base_sketch_names:
+            self._strategy = "vmap"
+        else:
+            if base.full_state_update is not False:
+                raise MetricsTPUUserError(
+                    "MultiStreamMetric's segment path needs full_state_update=False on "
+                    f"the base ({type(base).__name__} declares "
+                    f"{base.full_state_update!r}): per-row updates must be independent "
+                    "of accumulated state to fold as a segment reduction"
+                )
+            self._strategy = "segment"
+
+        # every flat base state key, in base registration order — the slice of
+        # our stacked state handed to the vmapped base compute/update
+        self._base_state_keys: List[str] = list(base._defaults.keys())
+        self.add_state(
+            self._ROWS_STATE, jnp.zeros((self.num_streams,), jnp.int32), dist_reduce_fx="sum"
+        )
+        self.add_state(self._DROPPED_STATE, jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        # the wrapped update/compute only trace if the base's do
+        self.jit_update = self.jit_update and base.jit_update
+        self.jit_compute = self.jit_compute and base.jit_compute
+        self._active_reported = 0
+
+    # ------------------------------------------------------------------ update
+    def _check_update_inputs(
+        self, stream_ids: Any, args: tuple, kwargs: dict
+    ) -> Tuple[Array, list, Any, list, list, Optional[int]]:
+        """Shared update validation.  Runs eagerly from :meth:`_pre_update`
+        (so malformed calls raise at the call site even when the lazy queue
+        defers the body) and again inside :meth:`update` (shape/dtype checks
+        only touch statics, so they are trace-safe)."""
+        if stream_ids is None:
+            raise MetricsTPUUserError(
+                "MultiStreamMetric.update needs stream_ids= assigning each input row "
+                "to a stream"
+            )
+        ids = jnp.ravel(jnp.asarray(stream_ids))
+        if not jnp.issubdtype(ids.dtype, jnp.integer):
+            raise MetricsTPUUserError(f"stream_ids must be integers, got dtype {ids.dtype}")
+        ids = ids.astype(jnp.int32)
+        leaves, treedef, is_batched, statics, n, ragged = _flatten_batched_inputs(args, kwargs)
+        if n is None:
+            raise MetricsTPUUserError(
+                "MultiStreamMetric.update needs array inputs with a leading row axis"
+            )
+        if ragged or n != ids.shape[0]:
+            raise MetricsTPUUserError(
+                "every array input must carry the same leading row axis as stream_ids "
+                f"(got stream_ids of length {ids.shape[0]})"
+            )
+        if self._strategy == "vmap":
+            for leaf, b in zip(leaves, is_batched):
+                if b and not jnp.issubdtype(leaf.dtype, jnp.floating):
+                    raise MetricsTPUUserError(
+                        "the vmapped (sketch) multistream path pads per-stream rows "
+                        f"with NaN, which needs floating inputs; got dtype {leaf.dtype}"
+                    )
+        return ids, leaves, treedef, is_batched, statics, n
+
+    def _pre_update(self, *args: Any, **kwargs: Any) -> None:
+        kwargs = dict(kwargs)
+        stream_ids = kwargs.pop("stream_ids", None)
+        self._check_update_inputs(stream_ids, args, kwargs)
+        # eager mode-locking etc. happens on the base with concrete inputs
+        self._base._pre_update(*args, **kwargs)
+        _obs.counter_inc(
+            "multistream.scatter_updates", metric=type(self._base).__name__
+        )
+
+    def update(self, *args: Any, stream_ids: Any = None, **kwargs: Any) -> None:
+        ids, leaves, treedef, is_batched, statics, n = self._check_update_inputs(
+            stream_ids, args, kwargs
+        )
+        if n == 0:
+            return
+        batched = tuple(x for x, b in zip(leaves, is_batched) if b)
+
+        def _rebuild(row_leaves: Sequence[Any]) -> Tuple[tuple, dict]:
+            it = iter(row_leaves)
+            rebuilt = [next(it) if b else s for b, s in zip(is_batched, statics)]
+            return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+        S = self.num_streams
+        valid = (ids >= 0) & (ids < S)
+        # out-of-range rows route to segment S, which every scatter drops
+        ids_safe = jnp.where(valid, ids, S)
+        if self._strategy == "segment":
+            self._segment_update(ids_safe, valid, batched, _rebuild, n)
+        else:
+            self._vmap_update(ids_safe, valid, batched, _rebuild, n)
+
+    def _segment_update(
+        self, ids_safe: Array, valid: Array, batched: tuple, _rebuild: Callable, n: int
+    ) -> None:
+        S = self.num_streams
+        default_state = self._base.init_state()
+
+        def one_row(row_leaves: tuple) -> Dict[str, Any]:
+            a, kw = _rebuild(row_leaves)
+            return self._base.apply_update(dict(default_state), *a, **kw)
+
+        # rows keep a leading axis of 1 so the base sees ordinary (1, ...)
+        # batches — no metric has to special-case 0-d inputs
+        lifted = tuple(x.reshape((n, 1) + x.shape[1:]) for x in batched)
+        per_row = jax.vmap(one_row)(lifted)
+        counts = jax.ops.segment_sum(valid.astype(jnp.int32), ids_safe, num_segments=S)
+        for name, fx in self._base_tensor_reduces.items():
+            live = self._state[name]
+            rows = per_row[name]
+            if fx == "sum":
+                # zero default (validated at construction): per-row states ARE
+                # the per-row contributions, so the scatter-add is exact
+                self._state[name] = live + jax.ops.segment_sum(
+                    rows, ids_safe, num_segments=S
+                ).astype(live.dtype)
+            elif fx == "max":
+                seg = jax.ops.segment_max(rows, ids_safe, num_segments=S)
+                self._state[name] = jnp.maximum(live, seg.astype(live.dtype))
+            else:  # min
+                seg = jax.ops.segment_min(rows, ids_safe, num_segments=S)
+                self._state[name] = jnp.minimum(live, seg.astype(live.dtype))
+        self._state[self._ROWS_STATE] = self._state[self._ROWS_STATE] + counts
+        self._state[self._DROPPED_STATE] = self._state[self._DROPPED_STATE] + (
+            n - counts.sum()
+        ).astype(jnp.int32)
+
+    def _rows_capacity(self, n: int) -> int:
+        if self.max_rows_per_stream is not None:
+            return min(self.max_rows_per_stream, n)
+        return min(n, max(8, -(-4 * n // self.num_streams)))
+
+    def _vmap_update(
+        self, ids_safe: Array, valid: Array, batched: tuple, _rebuild: Callable, n: int
+    ) -> None:
+        S = self.num_streams
+        m = self._rows_capacity(n)
+        # bucket rows by stream: stable sort by id, then each row's slot is
+        # its rank within its id group — all static-shape ops
+        order = jnp.argsort(ids_safe, stable=True)
+        sorted_ids = ids_safe[order]
+        pos = jnp.arange(n, dtype=jnp.int32) - jnp.searchsorted(
+            sorted_ids, sorted_ids, side="left"
+        ).astype(jnp.int32)
+        keep = (sorted_ids < S) & (pos < m)
+        # overflow/invalid rows scatter to row S, which mode="drop" discards
+        row_ids = jnp.where(keep, sorted_ids, S)
+        slot = jnp.minimum(pos, m - 1)
+        staged = []
+        for leaf in batched:
+            stage = jnp.full((S, m) + leaf.shape[1:], jnp.nan, leaf.dtype)
+            staged.append(stage.at[row_ids, slot].set(leaf[order], mode="drop"))
+
+        def one_stream(stream_state: Dict[str, Any], stream_rows: tuple) -> Dict[str, Any]:
+            a, kw = _rebuild(stream_rows)
+            return self._base.apply_update(stream_state, *a, **kw)
+
+        lane_state = {k: self._state[k] for k in self._base_state_keys}
+        new_state = jax.vmap(one_stream)(lane_state, tuple(staged))
+        for k in self._base_state_keys:
+            self._state[k] = new_state[k]
+        counts = jax.ops.segment_sum(
+            keep.astype(jnp.int32), row_ids, num_segments=S
+        )
+        self._state[self._ROWS_STATE] = self._state[self._ROWS_STATE] + counts
+        self._state[self._DROPPED_STATE] = self._state[self._DROPPED_STATE] + (
+            n - counts.sum()
+        ).astype(jnp.int32)
+
+    # ----------------------------------------------------------------- compute
+    def compute(self) -> Any:
+        """Every stream's value, stacked on a leading ``(num_streams, ...)``
+        axis (a device array — nothing lands on the host until the caller
+        converts it)."""
+        if not self._state_swapped:
+            self._flush_pending()
+        lane_state = {k: self._state[k] for k in self._base_state_keys}
+        return jax.vmap(self._base.apply_compute)(lane_state)
+
+    # -------------------------------------------------------------- query path
+    def _with_query_state(self, fn: Callable[[Dict[str, Any]], Any]) -> Any:
+        """Run ``fn`` against the queryable state: pending updates flushed
+        and, when ``sync_on_compute`` asks for it, synced across ranks for
+        the duration of the query (then unsynced, mirroring ``compute``).
+        The device arrays ``fn`` derives stay valid after the unsync."""
+        self._flush_pending()
+        self._flush_host_buffers()
+        if self._is_synced or not self.sync_on_compute:
+            return fn(self._state)
+        with self.sync_context(should_sync=True):
+            return fn(self._state)
+
+    def _report_active(self, state: Dict[str, Any]) -> None:
+        active = int(np.asarray(jnp.count_nonzero(state[self._ROWS_STATE])))
+        if active > self._active_reported:
+            _obs.counter_inc(
+                "multistream.streams_active",
+                active - self._active_reported,
+                metric=type(self._base).__name__,
+            )
+            self._active_reported = active
+
+    def compute_streams(self, stream_ids: Any) -> Any:
+        """Base values for just the given streams: gathers ``len(stream_ids)``
+        state rows on device and computes only those — O(k), not O(S)."""
+        ids = jnp.ravel(jnp.asarray(stream_ids)).astype(jnp.int32)
+
+        def query(state: Dict[str, Any]) -> Any:
+            self._report_active(state)
+            lane_state = {k: state[k][ids] for k in self._base_state_keys}
+            return jax.vmap(self._base.apply_compute)(lane_state)
+
+        return self._with_query_state(query)
+
+    def _stream_scores(self, state: Dict[str, Any], key: Any) -> Array:
+        lane_state = {k: state[k] for k in self._base_state_keys}
+        values = jax.vmap(self._base.apply_compute)(lane_state)
+        if key is not None:
+            if isinstance(values, dict):
+                values = values[key]
+            elif isinstance(key, int):
+                # component index into the per-stream value, not the stream axis
+                values = jnp.asarray(values)[..., key]
+            else:
+                values = getattr(values, key)
+        values = jnp.asarray(values)
+        if values.ndim != 1:
+            raise MetricsTPUUserError(
+                f"stream ranking needs one scalar per stream; compute gives shape "
+                f"{values.shape} — pass key= to select a scalar component"
+            )
+        return values
+
+    def top_k(self, k: int, key: Any = None, largest: bool = True) -> Tuple[Array, Array]:
+        """The ``k`` highest-valued streams as ``(values, stream_ids)`` device
+        arrays of shape ``(k,)`` — ranking runs on device (``lax.top_k``)
+        and only these ``k`` rows ever reach the host.
+
+        ``key`` selects a scalar component when the base compute returns a
+        dict (by key) or a tuple/vector (by index).  NaN scores (typically
+        untouched streams) always rank last.
+        """
+        k = int(k)
+        if not 1 <= k <= self.num_streams:
+            raise ValueError(f"k must be in [1, {self.num_streams}], got {k}")
+        _obs.counter_inc("multistream.topk_queries", metric=type(self._base).__name__)
+
+        def query(state: Dict[str, Any]) -> Tuple[Array, Array]:
+            self._report_active(state)
+            values = self._stream_scores(state, key)
+            fill = -jnp.inf if largest else jnp.inf
+            score = jnp.where(jnp.isnan(values), fill, values.astype(jnp.float32))
+            if not largest:
+                score = -score
+            _, idx = lax.top_k(score, k)
+            return values[idx], idx
+
+        return self._with_query_state(query)
+
+    def bottom_k(self, k: int, key: Any = None) -> Tuple[Array, Array]:
+        """The ``k`` lowest-valued streams as ``(values, stream_ids)`` — see
+        :meth:`top_k`."""
+        return self.top_k(k, key=key, largest=False)
+
+    def where(self, pred: Callable[[Array], Array], k: int, key: Any = None) -> Tuple[Array, Array]:
+        """Up to ``k`` stream ids whose value satisfies ``pred`` (a traced
+        elementwise predicate over the per-stream value vector), plus the
+        total match count.
+
+        Returns ``(ids, total)``: ``ids`` is a ``(k,)`` device vector holding
+        the lowest-numbered matching streams first, padded with ``-1``;
+        ``total`` is a scalar with the full match count (which may exceed
+        ``k``).  Shapes stay static — ``k`` bounds the host transfer.
+        """
+        k = int(k)
+        if not 1 <= k <= self.num_streams:
+            raise ValueError(f"k must be in [1, {self.num_streams}], got {k}")
+        _obs.counter_inc("multistream.topk_queries", metric=type(self._base).__name__)
+
+        def query(state: Dict[str, Any]) -> Tuple[Array, Array]:
+            self._report_active(state)
+            values = self._stream_scores(state, key)
+            mask = jnp.asarray(pred(values)).astype(bool)
+            if mask.shape != values.shape:
+                raise MetricsTPUUserError(
+                    f"where() predicate must be elementwise; got shape {mask.shape} "
+                    f"for values of shape {values.shape}"
+                )
+            mask = mask & ~jnp.isnan(values)
+            total = jnp.sum(mask.astype(jnp.int32))
+            # score matches by -id so lax.top_k yields the lowest ids first
+            score = jnp.where(
+                mask, -jnp.arange(self.num_streams, dtype=jnp.float32), -jnp.inf
+            )
+            top, idx = lax.top_k(score, k)
+            return jnp.where(jnp.isfinite(top), idx, -1), total
+
+        return self._with_query_state(query)
+
+    def active_streams(self) -> int:
+        """How many streams have received at least one row (host int)."""
+        self._flush_pending()
+        return int(np.asarray(jnp.count_nonzero(self._state[self._ROWS_STATE])))
+
+    def dropped_rows(self) -> int:
+        """Rows dropped so far: out-of-range ids, plus per-call overflow past
+        ``max_rows_per_stream`` on the vmapped path (host int)."""
+        self._flush_pending()
+        return int(np.asarray(self._state[self._DROPPED_STATE]))
+
+    # ------------------------------------------------------------------- misc
+    def _finish_sync_report(self, report: Dict[str, Any], backend: Any, start: float) -> None:
+        super()._finish_sync_report(report, backend, start)
+        gathered = int(report.get("bytes_gathered") or 0)
+        if gathered:
+            # attribute stacked-state sync traffic to the multistream layer
+            _obs.counter_inc(
+                "multistream.sync_bytes", gathered, metric=type(self._base).__name__
+            )
+
+    def _ckpt_extra_state(self) -> Dict[str, Any]:
+        # runtime-locked base attrs (e.g. a classifier's input ``mode``) live
+        # on the template metric, so a checkpoint restore must route them there
+        out = super()._ckpt_extra_state()
+        base_extra = self._base._ckpt_extra_state()
+        if base_extra:
+            out["base"] = base_extra
+        return out
+
+    def _ckpt_load_extra_state(self, extra: Dict[str, Any]) -> None:
+        base_extra = extra.get("base")
+        super()._ckpt_load_extra_state({k: v for k, v in extra.items() if k != "base"})
+        if isinstance(base_extra, dict):
+            self._base._ckpt_load_extra_state(base_extra)
+
+    def reset(self) -> None:
+        super().reset()
+        self._base.reset()
+        self._active_reported = 0
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(base={type(self._base).__name__}, "
+            f"num_streams={self.num_streams})"
+        )

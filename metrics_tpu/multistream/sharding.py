@@ -1,0 +1,72 @@
+"""Placement helpers: shard the stream axis across devices.
+
+The stacked ``(num_streams, ...)`` states of a :class:`MultiStreamMetric`
+are embarrassingly parallel along the stream axis — each device can own a
+contiguous shard of streams and the scatter update, vmapped compute, and
+``lax.top_k`` query all partition cleanly.  These helpers follow the
+SNIPPETS sharding-utility pattern: a 1-D device mesh with a ``'batch'``
+axis, ``NamedSharding(mesh, P('batch'))`` on the leading (stream) axis of
+every stacked state, and replication for the scalar bookkeeping states.
+"""
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu.multistream.core import MultiStreamMetric
+
+__all__ = ["stream_mesh", "stream_sharding", "replicate_sharding", "shard_streams"]
+
+
+def stream_mesh(devices: Optional[Any] = None, axis_name: str = "batch") -> Mesh:
+    """A 1-D mesh over ``devices`` (default: all local devices) whose single
+    axis carries the stream dimension."""
+    import numpy as np
+
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs.reshape(-1), (axis_name,))
+
+
+def stream_sharding(mesh: Optional[Mesh] = None, axis_name: str = "batch") -> NamedSharding:
+    """``NamedSharding`` splitting the leading (stream) axis across the mesh."""
+    mesh = mesh if mesh is not None else stream_mesh(axis_name=axis_name)
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicate_sharding(mesh: Optional[Mesh] = None, axis_name: str = "batch") -> NamedSharding:
+    """``NamedSharding`` replicating a value on every device of the mesh."""
+    mesh = mesh if mesh is not None else stream_mesh(axis_name=axis_name)
+    return NamedSharding(mesh, P())
+
+
+def shard_streams(
+    metric: MultiStreamMetric,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "batch",
+) -> MultiStreamMetric:
+    """Place a :class:`MultiStreamMetric`'s stacked states on a device mesh.
+
+    Every state whose leading axis is the stream axis is ``device_put`` with
+    ``P(axis_name)`` (stream-sharded); scalar states replicate.  Subsequent
+    jitted updates/computes follow the placement, so per-stream work runs
+    where its shard lives.  ``num_streams`` must divide the mesh size.
+
+    Returns the metric (placement happens in place).
+    """
+    mesh = mesh if mesh is not None else stream_mesh(axis_name=axis_name)
+    split = stream_sharding(mesh, axis_name)
+    replicate = replicate_sharding(mesh, axis_name)
+    n_dev = mesh.devices.size
+    if metric.num_streams % n_dev:
+        raise ValueError(
+            f"num_streams={metric.num_streams} must divide evenly over the "
+            f"{n_dev}-device mesh"
+        )
+    metric._flush_pending()
+    for name, value in metric._state.items():
+        if not hasattr(value, "ndim"):
+            continue
+        is_stacked = value.ndim >= 1 and value.shape[0] == metric.num_streams
+        metric._state[name] = jax.device_put(value, split if is_stacked else replicate)
+    return metric

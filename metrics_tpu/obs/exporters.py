@@ -186,6 +186,7 @@ def summarize_counters(
     by_metric: Dict[str, float] = {}
     sync: Dict[str, float] = {}
     streaming: Dict[str, float] = {}
+    multistream: Dict[str, float] = {}
     ckpt: Dict[str, float] = {}
     iou_hits = iou_misses = 0.0
     fallbacks = 0.0
@@ -204,6 +205,9 @@ def summarize_counters(
         elif name.startswith("streaming."):
             field = name[len("streaming."):]
             streaming[field] = streaming.get(field, 0) + value
+        elif name.startswith("multistream."):
+            field = name[len("multistream."):]
+            multistream[field] = multistream.get(field, 0) + value
         elif name.startswith("ckpt."):
             field = name[len("ckpt."):]
             ckpt[field] = ckpt.get(field, 0) + value
@@ -227,6 +231,8 @@ def summarize_counters(
         }
     if streaming:
         out["streaming"] = {k: int(v) for k, v in sorted(streaming.items())}
+    if multistream:
+        out["multistream"] = {k: int(v) for k, v in sorted(multistream.items())}
     if ckpt:
         out["ckpt"] = {k: int(v) for k, v in sorted(ckpt.items())}
     if iou_hits or iou_misses:

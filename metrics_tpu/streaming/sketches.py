@@ -142,12 +142,22 @@ def _fold_chunks(buf, cnt, key, nc, chunks, valids, level):
         chunk, valid = xs
         key, sub = jax.random.split(key)
         rbits = jax.random.randint(sub, (levels,), 0, 2, dtype=jnp.int32)
-        for h in range(levels - 1, level - 1, -1):
-            buf, cnt, nc = _maybe_compact(buf, cnt, nc, rbits[h], h)
-        masked = jnp.where(jnp.arange(half) < valid, chunk, _INF).astype(buf.dtype)
-        row = lax.dynamic_update_slice(buf[level], masked, (cnt[level],))
-        buf = buf.at[level].set(row)
-        cnt = cnt.at[level].add(valid)
+
+        def fold(buf, cnt, nc):
+            for h in range(levels - 1, level - 1, -1):
+                buf, cnt, nc = _maybe_compact(buf, cnt, nc, rbits[h], h)
+            masked = jnp.where(jnp.arange(half) < valid, chunk, _INF).astype(buf.dtype)
+            row = lax.dynamic_update_slice(buf[level], masked, (cnt[level],))
+            buf = buf.at[level].set(row)
+            cnt = cnt.at[level].add(valid)
+            return buf, cnt, nc
+
+        # an all-padding chunk must be a true no-op: letting it reach
+        # _maybe_compact can fire a spurious compaction (padded fixed-width
+        # callers routinely produce empty tail chunks)
+        buf, cnt, nc = lax.cond(
+            valid > 0, fold, lambda b, c, m: (b, c, m), buf, cnt, nc
+        )
         return (buf, cnt, key, nc), None
 
     (buf, cnt, key, nc), _ = lax.scan(body, (buf, cnt, key, nc), (chunks, valids))

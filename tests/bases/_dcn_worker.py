@@ -32,6 +32,14 @@ Streaming scenario:
   quantiles land within the sketch's rank-error bound of the exact
   quantiles of the UNION stream, and unsync must restore the local-only
   sketch afterwards.
+
+Multistream scenario:
+
+* ``multistream`` — each rank feeds a disjoint stream range of a
+  :class:`MultiStreamMetric` fleet (stacked Accuracy sums + stacked
+  quantile sketches); one cross-host ``compute()`` must land every rank on
+  the per-stream values of the union, and unsync must restore the
+  local-only stacked state.
 """
 
 import os
@@ -206,6 +214,79 @@ def _scenario_sketch(rank: int, nproc: int) -> None:
     print(f"DCN_SKETCH_OK rank={rank}", flush=True)
 
 
+def _scenario_multistream(rank: int, nproc: int) -> None:
+    """Disjoint per-rank stream ranges through one stacked-state sync.
+
+    Rank r feeds only streams ``[r*S/nproc, (r+1)*S/nproc)``; after one
+    cross-host ``compute()`` every rank must hold the per-stream values of
+    the UNION — sum states ride the ordinary sum reduction (the absent
+    rank contributes zero rows), sketch states ride the vmapped merge —
+    and unsync must restore the local-only stacked state.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu import MultiStreamMetric
+    from metrics_tpu.classification import Accuracy
+    from metrics_tpu.obs import counters_snapshot
+    from metrics_tpu.streaming import StreamingQuantile
+
+    S = 16
+    span = S // nproc
+    lo = rank * span
+
+    def rank_rows(r: int):
+        rng = np.random.default_rng(6000 + r)
+        n = 48
+        ids = rng.integers(r * span, (r + 1) * span, n)
+        preds = rng.integers(0, 4, n)
+        target = rng.integers(0, 4, n)
+        vals = rng.normal(size=n).astype(np.float32)
+        return ids, preds, target, vals
+
+    ids, preds, target, vals = rank_rows(rank)
+    acc = MultiStreamMetric(Accuracy(num_classes=4, validate_args=False), num_streams=S)
+    # capacity 16 > 48/span rows per stream: sketches stay uncompacted, so
+    # the merged medians are EXACT and the union check is equality
+    q = MultiStreamMetric(
+        StreamingQuantile(capacity=16, max_items=4096),
+        num_streams=S,
+        max_rows_per_stream=16,
+    )
+    acc.update(jnp.asarray(preds), jnp.asarray(target), stream_ids=jnp.asarray(ids))
+    q.update(jnp.asarray(vals), stream_ids=jnp.asarray(ids))
+
+    got_acc = np.asarray(acc.compute())
+    got_q = np.asarray(q.compute())
+
+    # union reference: every stream's rows live on exactly one rank
+    want_acc = np.zeros(S)
+    want_q = np.zeros(S)
+    for r in range(nproc):
+        rids, rpreds, rtarget, rvals = rank_rows(r)
+        for s in range(r * span, (r + 1) * span):
+            rows = rids == s
+            want_acc[s] = (rpreds[rows] == rtarget[rows]).mean()
+            want_q[s] = np.quantile(rvals[rows], 0.5, method="lower")
+    np.testing.assert_allclose(got_acc, want_acc, rtol=1e-6)
+    # exact uncompacted sketches: the merged median is a data point
+    np.testing.assert_allclose(got_q, want_q, rtol=1e-6)
+
+    # unsync restored the local stacked state: only this rank's streams active
+    assert not acc._is_synced and not q._is_synced
+    assert acc.active_streams() == span, (acc.active_streams(), span)
+    local = np.asarray(acc._state["stream_rows"])
+    assert local[lo:lo + span].sum() == 48 and local.sum() == 48
+
+    sync_bytes = sum(
+        v
+        for (name, _labels), v in counters_snapshot().items()
+        if name == "multistream.sync_bytes"
+    )
+    assert sync_bytes > 0, "stacked-state sync traffic was never attributed"
+    print(f"DCN_MULTISTREAM_OK rank={rank}", flush=True)
+
+
 def _ckpt_collection():
     from metrics_tpu import CatMetric, MetricCollection
     from metrics_tpu.classification import Accuracy
@@ -303,6 +384,9 @@ def main() -> None:
         return
     if scenario == "sketch":
         _scenario_sketch(rank, nproc)
+        return
+    if scenario == "multistream":
+        _scenario_multistream(rank, nproc)
         return
     if scenario == "ckpt_save":
         _scenario_ckpt_save(rank, nproc)

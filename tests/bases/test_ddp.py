@@ -193,6 +193,18 @@ def test_multihost_sketch_merge_two_process():
 
 
 @pytest.mark.slow
+def test_multihost_multistream_two_process():
+    """Real 2-process multistream sync: each rank feeds a disjoint stream
+    range of a ``MultiStreamMetric`` fleet; one cross-host compute must land
+    every rank on the per-stream values of the union — stacked sums through
+    the ordinary sum reduction, stacked sketches through the vmapped merge —
+    and unsync must restore the local-only stacked state."""
+    for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="multistream", timeout=120)):
+        assert code == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_MULTISTREAM_OK rank={r}" in out
+
+
+@pytest.mark.slow
 def test_multihost_checkpoint_save_kill_restore_resume(tmp_path):
     """Real 2-process preemption drill: first life accumulates and commits a
     checkpoint through the live coordination service (snapshot barrier, KV

@@ -1,0 +1,117 @@
+"""MultiStreamMetric composes with MetricCollection and device sharding.
+
+Two multistream wrappers over same-state bases share one compute group (the
+leader's scatter update runs once for both), and ``shard_streams`` places
+the stacked stream axis across a device mesh with no change in results —
+the test rig forces 8 virtual CPU devices, so a real mesh is available.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    MetricCollection,
+    MultiStreamMetric,
+    Precision,
+    Recall,
+)
+from metrics_tpu.multistream import shard_streams, stream_mesh
+
+S = 16
+B = 256
+
+
+def _batch(seed):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.integers(0, 4, B)),
+        jnp.asarray(rng.integers(0, 4, B)),
+        jnp.asarray(rng.integers(0, S, B)),
+    )
+
+
+class TestComputeGroups:
+    def test_two_multistream_share_one_group(self):
+        coll = MetricCollection(
+            {
+                "p": MultiStreamMetric(Precision(num_classes=4), num_streams=S),
+                "r": MultiStreamMetric(Recall(num_classes=4), num_streams=S),
+            }
+        )
+        preds, target, ids = _batch(30)
+        coll.update(preds, target, stream_ids=ids)
+        out = {k: np.asarray(v) for k, v in coll.compute().items()}
+
+        groups = [sorted(g) for g in coll.compute_groups.values()]
+        assert groups == [["p", "r"]]
+
+        for name, base in (("p", Precision(num_classes=4)), ("r", Recall(num_classes=4))):
+            solo = MultiStreamMetric(base, num_streams=S)
+            solo.update(preds, target, stream_ids=ids)
+            np.testing.assert_allclose(out[name], np.asarray(solo.compute()), rtol=1e-6)
+
+    def test_group_members_stay_independent_after_compute(self):
+        # macro averaging makes precision and recall genuinely differ (micro
+        # collapses both to accuracy), so aliasing between group members
+        # would show up as equal computes
+        coll = MetricCollection(
+            {
+                "p": MultiStreamMetric(Precision(num_classes=4, average="macro"), num_streams=S),
+                "r": MultiStreamMetric(Recall(num_classes=4, average="macro"), num_streams=S),
+            }
+        )
+        for seed in (31, 32):
+            preds, target, ids = _batch(seed)
+            coll.update(preds, target, stream_ids=ids)
+            out = coll.compute()
+            assert not np.allclose(
+                np.asarray(out["p"]), np.asarray(out["r"]), equal_nan=True
+            )
+
+
+class TestShardStreams:
+    def test_sharded_matches_unsharded(self):
+        assert jax.device_count() >= 8  # conftest forces 8 virtual CPU devices
+        preds, target, ids = _batch(33)
+        plain = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        plain.update(preds, target, stream_ids=ids)
+        want = np.asarray(plain.compute())
+
+        sharded = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        shard_streams(sharded, stream_mesh())
+        sharded.update(preds, target, stream_ids=ids)
+        np.testing.assert_allclose(np.asarray(sharded.compute()), want, rtol=1e-6)
+
+        # the stacked states actually live sharded across the mesh
+        rows = sharded._state[sharded._ROWS_STATE]
+        assert len(rows.sharding.device_set) == jax.device_count()
+
+    def test_sharded_queries_match(self):
+        preds, target, ids = _batch(34)
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        m.update(preds, target, stream_ids=ids)
+        top_want, idx_want = (np.asarray(x) for x in m.top_k(4))
+
+        sh = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        shard_streams(sh)
+        sh.update(preds, target, stream_ids=ids)
+        top_got, idx_got = (np.asarray(x) for x in sh.top_k(4))
+        np.testing.assert_allclose(top_got, top_want, rtol=1e-6)
+        np.testing.assert_array_equal(idx_got, idx_want)
+
+    def test_indivisible_stream_count_rejected(self):
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=10)
+        with pytest.raises(ValueError, match="divide"):
+            shard_streams(m, stream_mesh())
+
+
+class TestUnsupportedBases:
+    def test_buffer_state_base_rejected(self):
+        from metrics_tpu import SpearmanCorrCoef
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError, match="buffer"):
+            MultiStreamMetric(SpearmanCorrCoef(), num_streams=2)

@@ -1,0 +1,236 @@
+"""MultiStreamMetric ≡ S independent metrics, on every path that matters.
+
+The equivalence contract: updating one ``MultiStreamMetric`` with rows
+scattered by ``stream_ids`` must land every stream on exactly the value an
+independent singleton metric fed only that stream's rows would compute —
+locally, after a cross-rank sync, and across both update strategies
+(segment scatter for pure-tensor states, vmapped base update for sketch
+states).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    MeanSquaredError,
+    MultiStreamMetric,
+    StreamingQuantile,
+)
+from metrics_tpu.parallel.backend import LoopbackBackend
+
+S = 8
+B = 96
+
+
+def _batches(seed, n_batches=3):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "preds": rng.integers(0, 4, B),
+            "target": rng.integers(0, 4, B),
+            "vals": rng.normal(size=B).astype(np.float32),
+            "ids": rng.integers(0, S, B),
+        }
+        for _ in range(n_batches)
+    ]
+
+
+def _single_accuracy(batches, s):
+    m = Accuracy(num_classes=4)
+    for b in batches:
+        rows = b["ids"] == s
+        if rows.any():
+            m.update(jnp.asarray(b["preds"][rows]), jnp.asarray(b["target"][rows]))
+    return float(m.compute())
+
+
+class TestSegmentEquivalence:
+    def test_accuracy_matches_singletons(self):
+        batches = _batches(0)
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        for b in batches:
+            m.update(
+                jnp.asarray(b["preds"]), jnp.asarray(b["target"]), stream_ids=jnp.asarray(b["ids"])
+            )
+        got = np.asarray(m.compute())
+        want = [_single_accuracy(batches, s) for s in range(S)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert m.dropped_rows() == 0
+        assert m.active_streams() == S
+
+    def test_sum_state_regression_matches_singletons(self):
+        batches = _batches(1)
+        m = MultiStreamMetric(MeanSquaredError(), num_streams=S)
+        for b in batches:
+            m.update(
+                jnp.asarray(b["vals"]),
+                jnp.asarray(b["vals"] * 0.5),
+                stream_ids=jnp.asarray(b["ids"]),
+            )
+        got = np.asarray(m.compute())
+        for s in range(S):
+            single = MeanSquaredError()
+            for b in batches:
+                rows = b["ids"] == s
+                single.update(jnp.asarray(b["vals"][rows]), jnp.asarray(b["vals"][rows] * 0.5))
+            np.testing.assert_allclose(got[s], float(single.compute()), rtol=1e-5)
+
+    def test_out_of_range_ids_dropped_and_counted(self):
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=4)
+        preds = jnp.asarray([1, 2, 3, 1, 2, 3])
+        target = jnp.asarray([1, 2, 0, 1, 2, 0])
+        ids = jnp.asarray([0, 1, -1, 4, 2, 100])
+        m.update(preds, target, stream_ids=ids)
+        assert m.dropped_rows() == 3
+        got = np.asarray(m.compute())
+        np.testing.assert_allclose(got[:3], [1.0, 1.0, 1.0])
+
+    def test_untouched_streams_match_fresh_singleton(self):
+        m = MultiStreamMetric(MeanSquaredError(), num_streams=4)
+        m.update(
+            jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]), stream_ids=jnp.asarray([0, 0])
+        )
+        got = np.asarray(m.compute())
+        # stream 0 has data; streams 1-3 compute the 0/0 default (NaN),
+        # exactly what a fresh singleton MeanSquaredError computes
+        np.testing.assert_allclose(got[0], 0.5)
+        assert np.isnan(got[1:]).all()
+
+    def test_multibatch_is_one_trace(self):
+        from metrics_tpu.obs import counters_snapshot
+
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        batches = _batches(3, n_batches=2)
+        for b in batches:  # warm every trace
+            m.update(
+                jnp.asarray(b["preds"]), jnp.asarray(b["target"]), stream_ids=jnp.asarray(b["ids"])
+            )
+        np.asarray(m.compute())
+        before = counters_snapshot()
+        for b in _batches(4, n_batches=3):
+            m.update(
+                jnp.asarray(b["preds"]), jnp.asarray(b["target"]), stream_ids=jnp.asarray(b["ids"])
+            )
+        np.asarray(m.compute())
+        delta = {
+            k: v - before.get(k, 0)
+            for k, v in counters_snapshot().items()
+            if v != before.get(k, 0)
+        }
+        recompiles = sum(int(v) for (name, _l), v in delta.items() if name == "jit_traces")
+        assert recompiles == 0, delta
+
+
+class TestVmapEquivalence:
+    def test_quantile_matches_singletons_exactly(self):
+        # KLL compacts once a level holds more than capacity/2 entries at a
+        # fold boundary, and compaction coin flips differ per stream key —
+        # capacity 64 keeps every stream (~24 rows) strictly uncompacted, so
+        # the per-stream medians are exact and equality is deterministic
+        batches = _batches(5, n_batches=2)
+        m = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096), num_streams=S, max_rows_per_stream=32
+        )
+        for b in batches:
+            m.update(jnp.asarray(b["vals"]), stream_ids=jnp.asarray(b["ids"]))
+        got = np.asarray(m.compute())
+        for s in range(S):
+            single = StreamingQuantile(capacity=64, max_items=4096)
+            for b in batches:
+                single.update(jnp.asarray(b["vals"][b["ids"] == s]))
+            np.testing.assert_allclose(got[s], float(single.compute()), rtol=1e-6)
+        assert m.dropped_rows() == 0
+
+    def test_row_overflow_dropped_and_counted(self):
+        m = MultiStreamMetric(
+            StreamingQuantile(capacity=16, max_items=4096), num_streams=4, max_rows_per_stream=2
+        )
+        # 5 rows land on stream 0 with a 2-row per-call capacity
+        m.update(
+            jnp.asarray(np.arange(5, dtype=np.float32)), stream_ids=jnp.asarray([0, 0, 0, 0, 0])
+        )
+        assert m.dropped_rows() == 3
+        # the first two rows (stable order) survived
+        np.testing.assert_allclose(float(np.asarray(m.compute())[0]), 0.0)
+
+    def test_integer_inputs_rejected_on_vmap_path(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        m = MultiStreamMetric(
+            StreamingQuantile(capacity=16, max_items=256), num_streams=2, lazy_updates=0
+        )
+        with pytest.raises(MetricsTPUUserError, match="floating"):
+            m.update(jnp.asarray([1, 2]), stream_ids=jnp.asarray([0, 1]))
+
+
+class TestSyncEquivalence:
+    def test_accuracy_after_loopback_sync(self):
+        batches = _batches(6)
+        m = MultiStreamMetric(
+            Accuracy(num_classes=4), num_streams=S, sync_backend=LoopbackBackend()
+        )
+        for b in batches:
+            m.update(
+                jnp.asarray(b["preds"]), jnp.asarray(b["target"]), stream_ids=jnp.asarray(b["ids"])
+            )
+        got = np.asarray(m.compute())  # compute syncs through the backend
+        want = [_single_accuracy(batches, s) for s in range(S)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert not m._is_synced  # unsync restored the local stacked state
+
+    def test_quantile_after_loopback_sync(self):
+        batches = _batches(7, n_batches=2)
+        m = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096),
+            num_streams=S,
+            max_rows_per_stream=32,
+            sync_backend=LoopbackBackend(),
+        )
+        for b in batches:
+            m.update(jnp.asarray(b["vals"]), stream_ids=jnp.asarray(b["ids"]))
+        got = np.asarray(m.compute())
+        for s in range(S):
+            single = StreamingQuantile(capacity=64, max_items=4096)
+            for b in batches:
+                single.update(jnp.asarray(b["vals"][b["ids"] == s]))
+            np.testing.assert_allclose(got[s], float(single.compute()), rtol=1e-6)
+
+
+class TestConstruction:
+    def test_list_state_base_rejected(self):
+        from metrics_tpu import CatMetric
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError, match="list"):
+            MultiStreamMetric(CatMetric(), num_streams=2)
+
+    def test_used_base_rejected(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        base = Accuracy(num_classes=4, lazy_updates=0)
+        base.update(jnp.asarray([1]), jnp.asarray([1]))
+        with pytest.raises(MetricsTPUUserError, match="fresh"):
+            MultiStreamMetric(base, num_streams=2)
+
+    def test_nested_multistream_rejected(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        inner = MultiStreamMetric(Accuracy(num_classes=4), num_streams=2)
+        with pytest.raises(MetricsTPUUserError, match="nest"):
+            MultiStreamMetric(inner, num_streams=2)
+
+    def test_missing_stream_ids_rejected(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=2)
+        with pytest.raises(MetricsTPUUserError, match="stream_ids"):
+            m.update(jnp.asarray([1]), jnp.asarray([1]))
+
+    def test_mismatched_row_axis_rejected(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=2)
+        with pytest.raises(MetricsTPUUserError, match="leading row axis"):
+            m.update(jnp.asarray([1, 0]), jnp.asarray([1, 0]), stream_ids=jnp.asarray([0]))

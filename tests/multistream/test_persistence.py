@@ -1,0 +1,213 @@
+"""MultiStreamMetric survives every persistence seam unchanged.
+
+Stacked states are ordinary tensor/sketch states, so ``state_dict`` /
+pickling, the checkpoint codec, and elastic ``merge_state`` folding all
+apply per-axis with no multistream-specific serialization code.  The one
+wrinkle is runtime-locked base attributes (a classifier's input ``mode``):
+``state_dict`` does not carry them (same contract as the bare base metric),
+while the checkpoint codec routes them through the wrapper's extra state.
+"""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MultiStreamMetric, StreamingQuantile
+from metrics_tpu.checkpoint.codec import (
+    arrays_to_merge_state,
+    arrays_to_pytree,
+    decode_metric,
+    encode_metric,
+)
+
+S = 8
+B = 96
+
+
+def _batches(seed, n_batches=2):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "preds": rng.integers(0, 4, B),
+            "target": rng.integers(0, 4, B),
+            "vals": rng.normal(size=B).astype(np.float32),
+            "ids": rng.integers(0, S, B),
+        }
+        for _ in range(n_batches)
+    ]
+
+
+def _feed_accuracy(m, batches):
+    for b in batches:
+        m.update(
+            jnp.asarray(b["preds"]), jnp.asarray(b["target"]), stream_ids=jnp.asarray(b["ids"])
+        )
+
+
+def _feed_quantile(m, batches):
+    for b in batches:
+        m.update(jnp.asarray(b["vals"]), stream_ids=jnp.asarray(b["ids"]))
+
+
+def _prime_mode(m):
+    """Lock the wrapped classifier's input mode (an eager, data-dependent
+    attribute that ``state_dict`` does not carry) with a throwaway multiclass
+    batch, then flush so the priming rows cannot outlive a state load."""
+    m.update(jnp.asarray([0, 3]), jnp.asarray([0, 3]), stream_ids=jnp.asarray([0, 0]))
+    np.asarray(m.compute())
+
+
+class TestStateDictRoundTrip:
+    def test_accuracy_state_dict(self):
+        batches = _batches(10)
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(m, batches)
+        want = np.asarray(m.compute())
+        m.persistent(True)
+        sd = m.state_dict()
+
+        m2 = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _prime_mode(m2)
+        m2.persistent(True)
+        m2.load_state_dict(sd)
+        np.testing.assert_array_equal(np.asarray(m2.compute()), want)
+        assert m2.active_streams() == m.active_streams()
+        assert m2.dropped_rows() == m.dropped_rows()
+
+    def test_load_invalidates_compute_cache(self):
+        # a cached compute() must not survive a state load (regression: the
+        # base class used to keep _computed across load_state_dict)
+        batches = _batches(11)
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(m, batches)
+        want = np.asarray(m.compute())
+        m.persistent(True)
+        sd = m.state_dict()
+
+        m2 = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _prime_mode(m2)
+        stale = np.asarray(m2.compute())  # populate the compute cache
+        m2.persistent(True)
+        m2.load_state_dict(sd)
+        got = np.asarray(m2.compute())
+        np.testing.assert_array_equal(got, want)
+        assert not np.array_equal(got, stale)
+
+    def test_pickle_round_trip_and_resume(self):
+        batches = _batches(12, n_batches=3)
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(m, batches[:2])
+        m2 = pickle.loads(pickle.dumps(m))
+        np.testing.assert_array_equal(
+            np.asarray(m2.compute()), np.asarray(m.compute())
+        )
+        # the clone keeps updating: feeding the tail batch matches a metric
+        # that saw the full stream
+        _feed_accuracy(m2, batches[2:])
+        ref = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(ref, batches)
+        np.testing.assert_array_equal(
+            np.asarray(m2.compute()), np.asarray(ref.compute())
+        )
+
+    def test_quantile_pickle_round_trip(self):
+        batches = _batches(13)
+        m = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096), num_streams=S, max_rows_per_stream=32
+        )
+        _feed_quantile(m, batches)
+        m2 = pickle.loads(pickle.dumps(m))
+        np.testing.assert_array_equal(
+            np.asarray(m2.compute()), np.asarray(m.compute())
+        )
+
+
+class TestCheckpointCodec:
+    def test_accuracy_ckpt_restores_into_fresh_instance(self):
+        # no mode priming here: the codec carries the wrapper's extra state,
+        # which routes the base classifier's locked mode through _base
+        batches = _batches(14)
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(m, batches)
+        want = np.asarray(m.compute())
+        enc = encode_metric(m)
+
+        dec = decode_metric(enc.blob, enc.digests)
+        assert not dec.failed
+        m2 = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        m2.load_state_pytree(arrays_to_pytree(m2, dec.arrays))
+        np.testing.assert_array_equal(np.asarray(m2.compute()), want)
+        assert m2.active_streams() == m.active_streams()
+
+    def test_sketch_ckpt_round_trip_bit_exact(self):
+        batches = _batches(15)
+        m = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096), num_streams=S, max_rows_per_stream=32
+        )
+        _feed_quantile(m, batches)
+        want = np.asarray(m.compute())
+        enc = encode_metric(m)
+
+        dec = decode_metric(enc.blob, enc.digests)
+        assert not dec.failed
+        m2 = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096), num_streams=S, max_rows_per_stream=32
+        )
+        m2.load_state_pytree(arrays_to_pytree(m2, dec.arrays))
+        np.testing.assert_array_equal(np.asarray(m2.compute()), want)
+
+    def test_corrupt_blob_reports_failed_states(self):
+        m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(m, _batches(16))
+        enc = encode_metric(m)
+        blob = bytearray(enc.blob)
+        blob[len(blob) // 2] ^= 0xFF
+        dec = decode_metric(bytes(blob), enc.digests)
+        assert dec.failed  # the flipped byte lands in some state's digest
+
+
+class TestElasticMerge:
+    def test_merge_checkpointed_fleet_accuracy(self):
+        # fleet B checkpoints, fleet A folds the decoded blob in — the union
+        # equals one fleet that saw every batch (sum states merge exactly)
+        batches = _batches(17, n_batches=4)
+        a = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(a, batches[:2])
+        b = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(b, batches[2:])
+        enc = encode_metric(b)
+
+        dec = decode_metric(enc.blob, enc.digests)
+        assert not dec.failed
+        a.merge_state(arrays_to_merge_state(a, dec.arrays), other_count=enc.update_count)
+        ref = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+        _feed_accuracy(ref, batches)
+        np.testing.assert_allclose(
+            np.asarray(a.compute()), np.asarray(ref.compute()), rtol=1e-6
+        )
+        assert a.active_streams() == ref.active_streams()
+
+    def test_merge_checkpointed_fleet_sketch_exact(self):
+        # ~12 rows/stream per fleet with capacity 64: both sketches and their
+        # merge stay uncompacted, so the union median is exactly the true one
+        batches = _batches(18, n_batches=2)
+        a = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096), num_streams=S, max_rows_per_stream=32
+        )
+        _feed_quantile(a, batches[:1])
+        b = MultiStreamMetric(
+            StreamingQuantile(capacity=64, max_items=4096), num_streams=S, max_rows_per_stream=32
+        )
+        _feed_quantile(b, batches[1:])
+        enc = encode_metric(b)
+
+        dec = decode_metric(enc.blob, enc.digests)
+        assert not dec.failed
+        a.merge_state(arrays_to_merge_state(a, dec.arrays))
+        got = np.asarray(a.compute())
+        for s in range(S):
+            rows = np.concatenate([bb["vals"][bb["ids"] == s] for bb in batches])
+            want = np.quantile(rows, 0.5, method="lower")
+            np.testing.assert_allclose(got[s], want, rtol=1e-6)

@@ -1,0 +1,174 @@
+"""Device-side stream queries: top_k / bottom_k / where / compute_streams.
+
+Ranking runs on device and only ``k`` rows reach the host; the observability
+counters attribute query and scatter traffic to the multistream layer and
+survive the Prometheus round trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MultiStreamMetric, StreamingQuantile
+
+S = 16
+B = 256
+
+
+def _fed_accuracy(seed=20):
+    rng = np.random.default_rng(seed)
+    preds = rng.integers(0, 4, B)
+    target = rng.integers(0, 4, B)
+    ids = rng.integers(0, S, B)
+    m = MultiStreamMetric(Accuracy(num_classes=4), num_streams=S)
+    m.update(jnp.asarray(preds), jnp.asarray(target), stream_ids=jnp.asarray(ids))
+    per_stream = np.asarray(m.compute())
+    return m, per_stream
+
+
+class TestTopK:
+    def test_top_k_matches_numpy_reference(self):
+        m, per_stream = _fed_accuracy()
+        k = 5
+        values, idx = m.top_k(k)
+        order = np.argsort(-per_stream, kind="stable")[:k]
+        got = sorted(zip(np.asarray(values).tolist(), np.asarray(idx).tolist()))
+        want = sorted(zip(per_stream[order].tolist(), order.tolist()))
+        np.testing.assert_allclose(
+            [v for v, _ in got], [v for v, _ in want], rtol=1e-6
+        )
+        # ties can reorder ids within equal values; the value multiset and
+        # the implied cutoff are what O(k) querying guarantees
+        assert min(v for v, _ in got) == pytest.approx(min(v for v, _ in want))
+
+    def test_top_k_is_o_of_k_host_transfer(self):
+        m, _ = _fed_accuracy()
+        k = 3
+        values, idx = m.top_k(k)
+        # the query returns device arrays of exactly k rows — converting them
+        # is the only host transfer the caller pays, never the full S streams
+        assert isinstance(values, jax.Array) and values.shape == (k,)
+        assert isinstance(idx, jax.Array) and idx.shape == (k,)
+
+    def test_bottom_k(self):
+        m, per_stream = _fed_accuracy()
+        values, idx = m.bottom_k(4)
+        worst = np.sort(per_stream)[:4]
+        np.testing.assert_allclose(np.sort(np.asarray(values)), worst, rtol=1e-6)
+
+    def test_nan_streams_rank_last(self):
+        # MeanSquaredError computes NaN on an untouched stream (0/0), so it
+        # exercises the NaN-always-last ranking rule
+        m = MultiStreamMetric(MeanSquaredError(), num_streams=4)
+        m.update(
+            jnp.asarray([1.0, 4.0]), jnp.asarray([0.0, 0.0]), stream_ids=jnp.asarray([0, 2])
+        )
+        values, idx = m.top_k(2)
+        assert set(np.asarray(idx).tolist()) == {0, 2}
+        assert not np.isnan(np.asarray(values)).any()
+
+    def test_k_out_of_range_rejected(self):
+        m, _ = _fed_accuracy()
+        with pytest.raises(ValueError, match="k must be"):
+            m.top_k(0)
+        with pytest.raises(ValueError, match="k must be"):
+            m.top_k(S + 1)
+
+    def test_int_key_selects_component(self):
+        rng = np.random.default_rng(21)
+        vals = rng.normal(size=B).astype(np.float32)
+        ids = rng.integers(0, S, B)
+        m = MultiStreamMetric(
+            StreamingQuantile(q=(0.25, 0.75), capacity=64, max_items=4096),
+            num_streams=S,
+            max_rows_per_stream=64,
+        )
+        m.update(jnp.asarray(vals), stream_ids=jnp.asarray(ids))
+        per_stream = np.asarray(m.compute())  # (S, 2)
+        values, idx = m.top_k(3, key=1)  # rank by p75, not the stream axis
+        np.testing.assert_allclose(
+            np.sort(np.asarray(values))[::-1], np.sort(per_stream[:, 1])[::-1][:3], rtol=1e-6
+        )
+
+    def test_vector_value_without_key_rejected(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        m = MultiStreamMetric(
+            StreamingQuantile(q=(0.25, 0.75), capacity=64, max_items=4096), num_streams=4
+        )
+        m.update(jnp.asarray([0.1, 0.2]), stream_ids=jnp.asarray([0, 1]))
+        with pytest.raises(MetricsTPUUserError, match="key="):
+            m.top_k(2)
+
+
+class TestWhere:
+    def test_where_ids_and_total(self):
+        m, per_stream = _fed_accuracy()
+        cut = float(np.median(per_stream))
+        k = S
+        ids, total = m.where(lambda v: v > cut, k)
+        want = np.nonzero(per_stream > cut)[0]
+        got = np.asarray(ids)
+        assert int(total) == len(want)
+        np.testing.assert_array_equal(got[: len(want)], want)
+        assert (got[len(want):] == -1).all()
+
+    def test_where_truncates_but_counts_all(self):
+        m, per_stream = _fed_accuracy()
+        ids, total = m.where(lambda v: v >= 0.0, 2)  # every fed stream matches
+        fed = np.nonzero(~np.isnan(per_stream))[0]
+        assert int(total) == len(fed)
+        np.testing.assert_array_equal(np.asarray(ids), fed[:2])
+
+    def test_where_excludes_nan_streams(self):
+        m = MultiStreamMetric(MeanSquaredError(), num_streams=4)
+        m.update(jnp.asarray([2.0]), jnp.asarray([0.0]), stream_ids=jnp.asarray([1]))
+        # an always-true predicate still only matches streams that hold data:
+        # NaN streams are masked out of both the ids and the total
+        ids, total = m.where(lambda v: v >= 0.0, 4)
+        assert int(total) == 1
+        np.testing.assert_array_equal(np.asarray(ids), [1, -1, -1, -1])
+
+
+class TestComputeStreams:
+    def test_matches_full_compute_rows(self):
+        m, per_stream = _fed_accuracy()
+        pick = jnp.asarray([3, 0, 11])
+        got = np.asarray(m.compute_streams(pick))
+        np.testing.assert_allclose(got, per_stream[np.asarray(pick)], rtol=1e-6)
+
+
+class TestObsCounters:
+    def test_counters_flow_through_summarize_and_prometheus(self):
+        from metrics_tpu.obs import counters_snapshot
+        from metrics_tpu.obs.exporters import (
+            parse_prometheus_text,
+            prometheus_text,
+            summarize_counters,
+        )
+
+        before = counters_snapshot()
+        m, _ = _fed_accuracy(seed=22)
+        m.top_k(3)
+        delta = {
+            k: v - before.get(k, 0)
+            for k, v in counters_snapshot().items()
+            if v - before.get(k, 0)
+        }
+        names = {name for name, _ in delta}
+        assert "multistream.scatter_updates" in names
+        assert "multistream.topk_queries" in names
+        assert "multistream.streams_active" in names
+
+        summary = summarize_counters(delta)
+        assert summary["multistream"]["scatter_updates"] >= 1
+        assert summary["multistream"]["topk_queries"] >= 1
+
+        parsed = parse_prometheus_text(prometheus_text())
+        multistream_series = {
+            name: value for (name, labels), value in parsed.items() if "multistream" in name
+        }
+        assert multistream_series, "multistream counters missing from exposition"
+        assert any("topk" in name for name in multistream_series)
+        assert all(value >= 1 for value in multistream_series.values())

@@ -1,15 +1,21 @@
-"""Tier-1 gate: streaming/ state code never uses data-dependent shapes."""
+"""Tier-1 gate: streaming/ and multistream/ state code never uses
+data-dependent shapes."""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
-from shape_lint import lint, lint_source  # noqa: E402
+from shape_lint import LINTED_DIRS, lint, lint_source  # noqa: E402
 
 
 def test_streaming_modules_are_shape_static():
     assert lint() == []
+
+
+def test_lint_covers_multistream():
+    covered = {os.path.basename(d) for d in LINTED_DIRS}
+    assert {"streaming", "multistream"} <= covered
 
 
 def test_lint_source_flags_dynamic_shapes():

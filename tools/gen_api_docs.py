@@ -26,6 +26,7 @@ DOMAINS = [
     ("wrappers", "Wrappers"),
     ("aggregation", "Aggregation"),
     ("streaming", "Streaming"),
+    ("multistream", "Multistream"),
     ("checkpoint", "Checkpoint"),
 ]
 

@@ -36,8 +36,12 @@ INSTRUMENTED_METHODS: Tuple[str, ...] = (
 # (qualified class name) -> methods it may override.  CompositionalMetric
 # re-dispatches through its operand metrics, each of which is spanned
 # individually, so its wrapper overrides do not lose telemetry.
+# MultiStreamMetric extends _finish_sync_report via super() to attribute
+# stacked-state sync traffic to the multistream.sync_bytes counter — the
+# base recording still runs first.
 ALLOWLIST: Dict[str, Set[str]] = {
     "metrics_tpu.metric.CompositionalMetric": {"_update_wrapper", "_compute_wrapper"},
+    "metrics_tpu.multistream.core.MultiStreamMetric": {"_finish_sync_report"},
 }
 
 

@@ -1,14 +1,15 @@
-"""Static check: ``metrics_tpu/streaming/`` never uses data-dependent shapes.
+"""Static check: fixed-shape subsystems never use data-dependent shapes.
 
-The streaming subsystem's whole contract is fixed-shape state: a jitted
-``update`` must never recompile as the stream grows, sketch states must pack
-into fixed-size sync blobs, and ring buffers must rotate in place.  One
-stray ``jnp.nonzero`` / ``.item()`` / boolean-mask extraction silently
-breaks that — it traces fine in eager tests and then either crashes under
-jit or, worse, forces a retrace per batch.
+The streaming and multistream subsystems' whole contract is fixed-shape
+state: a jitted ``update`` must never recompile as the stream grows, sketch
+states must pack into fixed-size sync blobs, ring buffers must rotate in
+place, and stacked ``(num_streams, ...)`` states must scatter without
+reshaping.  One stray ``jnp.nonzero`` / ``.item()`` / boolean-mask
+extraction silently breaks that — it traces fine in eager tests and then
+either crashes under jit or, worse, forces a retrace per batch.
 
 This linter AST-walks every module under ``metrics_tpu/streaming/`` and
-flags:
+``metrics_tpu/multistream/`` and flags:
 
 * calls producing data-dependent output shapes: ``nonzero``,
   ``flatnonzero``, ``argwhere``, ``unique``, ``extract``, ``compress``,
@@ -35,6 +36,12 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 STREAMING_DIR = os.path.join(_REPO_ROOT, "metrics_tpu", "streaming")
+
+# every directory whose modules must keep state math shape-static
+LINTED_DIRS = (
+    STREAMING_DIR,
+    os.path.join(_REPO_ROOT, "metrics_tpu", "multistream"),
+)
 
 # call names whose result shape depends on data values
 DYNAMIC_SHAPE_CALLS = {
@@ -106,17 +113,18 @@ def lint_source(src: str, filename: str) -> List[str]:
 
 
 def lint() -> List[str]:
-    """Lint every module under metrics_tpu/streaming/."""
+    """Lint every module under the shape-static subsystem directories."""
     problems: List[str] = []
-    for base, _dirs, files in sorted(os.walk(STREAMING_DIR)):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(base, fname)
-            with open(path, "r", encoding="utf-8") as fh:
-                src = fh.read()
-            rel = os.path.relpath(path, _REPO_ROOT)
-            problems.extend(lint_source(src, rel))
+    for lint_dir in LINTED_DIRS:
+        for base, _dirs, files in sorted(os.walk(lint_dir)):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(base, fname)
+                with open(path, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                rel = os.path.relpath(path, _REPO_ROOT)
+                problems.extend(lint_source(src, rel))
     return problems
 
 
@@ -127,7 +135,7 @@ def main() -> int:
     if problems:
         print(f"shape_lint: {len(problems)} violation(s)", file=sys.stderr)
         return 1
-    print("shape_lint: streaming/ state is shape-static")
+    print("shape_lint: streaming/ and multistream/ state is shape-static")
     return 0
 
 
