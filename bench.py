@@ -1092,6 +1092,102 @@ def _bench_multistream(num_streams=1024, n_batches=32, batch=4096, baseline_stre
     return fleet_rate, profile
 
 
+def _bench_serve(n_records=30_000, block_rows=256, num_streams=256, n_queries=60):
+    """Config 9: the serve subsystem end-to-end — sustained ingest + HTTP reads.
+
+    Prices the long-running-service pitch: records submitted one at a time
+    through the bounded queue, micro-batched by the consumer thread into
+    static-shape compiled blocks (padded multistream blocks, pow2 chunks for
+    the plain job), while real HTTP ``GET`` requests hit ``/query`` and
+    ``/metrics`` on the live server.  The ingest rate is records/s through
+    the whole pipeline (producer -> queue -> batcher -> jitted update,
+    flush included); query latency is wall-clock through the loopback TCP
+    stack, so it is an honest service number, not a function-call number.
+    """
+    import urllib.request
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.multistream import MultiStreamMetric
+    from metrics_tpu.obs import counters_snapshot, summarize_counters
+    from metrics_tpu.serve import EvalServer, MetricRegistry, ServeConfig
+
+    rng = np.random.default_rng(9)
+    registry = MetricRegistry()
+    registry.register("mse", MeanSquaredError())
+    registry.register(
+        "tenants",
+        MultiStreamMetric(MeanSquaredError(), num_streams=num_streams),
+        export_top_k=8,
+    )
+    server = EvalServer(
+        registry,
+        ServeConfig(
+            block_rows=block_rows, queue_capacity=65536, flush_interval=0.05
+        ),
+    ).start()
+    try:
+        preds = rng.uniform(size=n_records).astype(np.float32)
+        target = rng.uniform(size=n_records).astype(np.float32)
+        ids = rng.integers(0, num_streams, size=n_records).astype(np.int32)
+        # warm the compiled block shapes (and the query jits) out of the
+        # timed window
+        for i in range(block_rows):
+            server.submit("mse", (preds[i], target[i]), timeout=5.0)
+            server.submit("tenants", (preds[i], target[i]), stream_id=int(ids[i]), timeout=5.0)
+        server.flush()
+        base = f"http://127.0.0.1:{server.port}"
+        warm_paths = ("/query?job=mse", f"/query?job=tenants&top_k=8", "/metrics")
+        for path in warm_paths:
+            with urllib.request.urlopen(base + path, timeout=30.0) as resp:
+                resp.read()
+
+        before = counters_snapshot()
+        t0 = time.perf_counter()
+        for i in range(n_records):
+            tenants = bool(i & 1)
+            ok = server.submit(
+                "tenants" if tenants else "mse",
+                (preds[i], target[i]),
+                stream_id=int(ids[i]) if tenants else None,
+                timeout=5.0,
+            )
+            if not ok:
+                raise RuntimeError(f"bench submit rejected at record {i}")
+        server.flush()
+        ingest_secs = time.perf_counter() - t0
+        rate = n_records / ingest_secs
+
+        latencies = []
+        for i in range(n_queries):
+            path = warm_paths[i % len(warm_paths)]
+            q0 = time.perf_counter()
+            with urllib.request.urlopen(base + path, timeout=30.0) as resp:
+                resp.read()
+            latencies.append(time.perf_counter() - q0)
+        latencies.sort()
+
+        def _pct(q):
+            return latencies[min(len(latencies) - 1, int(q * len(latencies)))]
+
+        after = counters_snapshot()
+        serve_counters = summarize_counters(
+            {k: v - before.get(k, 0) for k, v in after.items()}
+        ).get("serve", {})
+        profile = {
+            "ingest_secs": round(ingest_secs, 3),
+            "records": n_records,
+            "block_rows": block_rows,
+            "num_streams": num_streams,
+            "query_p50_ms": round(_pct(0.50) * 1e3, 3),
+            "query_p99_ms": round(_pct(0.99) * 1e3, 3),
+            "http_requests": len(latencies),
+            "serve_counters": serve_counters,
+        }
+    finally:
+        server.stop(final_checkpoint=False)
+    return rate, profile
+
+
 def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -1199,6 +1295,7 @@ def main() -> None:
         ("config6_streaming_samples_per_sec", _bench_streaming),
         ("config7_checkpoint_write_mb_per_sec", _bench_checkpoint),
         ("config8_multistream_samples_per_sec", _bench_multistream),
+        ("config9_serve_ingest_records_per_sec", _bench_serve),
         ("device_mfu", _bench_mfu),
     ):
         obs_before = _obs_counters()
@@ -1254,6 +1351,15 @@ def main() -> None:
                 extra["config8_multistream_baseline_samples_per_sec"] = result[1][
                     "baseline_samples_per_sec"
                 ]
+            elif name.startswith("config9_serve"):
+                extra[name] = round(result[0], 1)
+                extra["config9_serve_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) still carries the serve telemetry
+                for key, val in (result[1].get("serve_counters") or {}).items():
+                    extra[f"config9_serve_{key}"] = val
+                extra["config9_serve_query_p50_ms"] = result[1]["query_p50_ms"]
+                extra["config9_serve_query_p99_ms"] = result[1]["query_p99_ms"]
             elif name == "device_mfu":
                 extra[name] = result
             else:

@@ -33,6 +33,7 @@ import hashlib
 import itertools
 import json
 import re
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -159,6 +160,14 @@ class CheckpointManager:
             emulate several ranks from one process.
         barrier_timeout: seconds to wait on peers during save commit and
             restore quorum.
+        max_staleness: cadence seam for long-running callers (the serve
+            durability loop): when set, :meth:`save_due` turns true once the
+            newest durable state is older than this many seconds, and
+            :meth:`maybe_save` commits a checkpoint exactly then.  The clock
+            starts at construction (or the last save/restore), so a
+            freshly-started caller does not checkpoint immediately.  ``None``
+            (default) means :meth:`maybe_save` only fires on an explicit
+            :meth:`request_save`.
     """
 
     def __init__(
@@ -171,6 +180,7 @@ class CheckpointManager:
         rank: Optional[int] = None,
         world_size: Optional[int] = None,
         barrier_timeout: float = 120.0,
+        max_staleness: Optional[float] = None,
     ) -> None:
         if store is None:
             if directory is None:
@@ -188,6 +198,13 @@ class CheckpointManager:
         self.rank = jax.process_index() if rank is None else int(rank)
         self.world_size = jax.process_count() if world_size is None else int(world_size)
         self.barrier_timeout = float(barrier_timeout)
+        if max_staleness is not None and not max_staleness > 0:
+            raise ValueError(f"`max_staleness` must be > 0 or None, got {max_staleness}")
+        self.max_staleness = None if max_staleness is None else float(max_staleness)
+        # staleness clock + "checkpoint now" trigger (set from any thread or a
+        # signal handler; honored by the next maybe_save)
+        self._durable_at = time.monotonic()
+        self._save_requested = threading.Event()
         # coordination-key namespace: shared by every rank's manager for the
         # same directory, disjoint across directories
         self._ns = hashlib.blake2b(self.store.root.encode(), digest_size=6).hexdigest()
@@ -254,7 +271,54 @@ class CheckpointManager:
             else:
                 self._await_commit(seq, step, sdir)
             counter_inc("ckpt.saves")
+        self._durable_at = time.monotonic()
         return step
+
+    # ------------------------------------------------------- cadence triggers
+
+    def request_save(self) -> None:
+        """Arm the "checkpoint now" trigger: the next :meth:`maybe_save` (or
+        :meth:`save_now`) commits regardless of staleness.  Safe to call from
+        any thread or a signal handler — the preemption-notice hook."""
+        self._save_requested.set()
+
+    def staleness(self) -> float:
+        """Seconds since the target was last known durable (last successful
+        ``save``/``restore`` through this manager, else construction)."""
+        return time.monotonic() - self._durable_at
+
+    def save_due(self) -> bool:
+        """Whether the cadence says it is time to checkpoint: an armed
+        :meth:`request_save`, or ``max_staleness`` exceeded."""
+        if self._save_requested.is_set():
+            return True
+        return self.max_staleness is not None and self.staleness() >= self.max_staleness
+
+    def seconds_until_due(self) -> Optional[float]:
+        """How long a durability loop may sleep before :meth:`save_due` turns
+        true (0 when already due, ``None`` when only an explicit
+        :meth:`request_save` can trigger)."""
+        if self._save_requested.is_set():
+            return 0.0
+        if self.max_staleness is None:
+            return None
+        return max(0.0, self.max_staleness - self.staleness())
+
+    def save_now(self, target: Target, step: Optional[int] = None) -> int:
+        """Unconditional checkpoint: commit, disarm any pending
+        :meth:`request_save`, and reset the staleness clock."""
+        committed = self.save(target, step=step)
+        self._save_requested.clear()
+        return committed
+
+    def maybe_save(self, target: Target, step: Optional[int] = None) -> Optional[int]:
+        """Commit a checkpoint iff :meth:`save_due`; returns the committed
+        step, or ``None`` when nothing was due.  The cadence primitive for
+        durability loops — callers stop hand-rolling last-save bookkeeping."""
+        if not self.save_due():
+            return None
+        counter_inc("ckpt.triggered_saves")
+        return self.save_now(target, step=step)
 
     def _verify_commit(self, sdir: str, step: int, payload: bytes) -> None:
         """Read the manifest back and make sure the commit actually stuck.
@@ -346,6 +410,8 @@ class CheckpointManager:
             )
             self._restore_from_manifest(target, manifest, result)
             counter_inc("ckpt.restores")
+        # the restored state IS durable: restart the staleness clock from it
+        self._durable_at = time.monotonic()
         return result
 
     def latest_step(self) -> Optional[int]:

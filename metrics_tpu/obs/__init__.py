@@ -33,6 +33,7 @@ from metrics_tpu.obs.core import (
 )
 from metrics_tpu.obs.exporters import (
     dump_json,
+    metric_values_prometheus_text,
     parse_prometheus_text,
     prometheus_text,
     report,
@@ -50,6 +51,7 @@ __all__ = [
     "dump_json",
     "enable",
     "enabled",
+    "metric_values_prometheus_text",
     "parse_prometheus_text",
     "prometheus_text",
     "record_sync_report",

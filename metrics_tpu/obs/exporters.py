@@ -7,8 +7,9 @@ used by tests to round-trip the output.
 """
 
 import json
+import math
 import re
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from metrics_tpu.obs.core import (
     CounterKey,
@@ -110,6 +111,63 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n" if lines else ""
 
 
+_METRIC_VALUE_GAUGE = _PROM_PREFIX + "metric_value"
+
+
+def _gauge_fmt(value: float) -> str:
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    return _fmt(value)
+
+
+def metric_values_prometheus_text(values: Any) -> str:
+    """Render *computed metric values* as labeled gauges.
+
+    The counters/spans in :func:`prometheus_text` describe the runtime; this
+    exporter describes the evaluation results themselves, as one gauge family
+    ``metrics_tpu_metric_value{job="..."}`` — the scrape surface the serve
+    layer's ``/metrics`` endpoint adds on top of the counters.
+
+    ``values`` is either a mapping ``job -> value`` or any object with an
+    ``export_values()`` method returning one (duck-typed so
+    ``metrics_tpu.serve.MetricRegistry`` plugs in without obs importing it).
+    Each value may be:
+
+    * a scalar (anything ``float()`` accepts) — one series per job;
+    * a mapping ``component -> scalar`` — one series per component, labeled
+      ``component="..."`` (dict-computing metrics, named vector components);
+    * an iterable of ``(labels_dict, scalar)`` pairs — arbitrary extra labels
+      (the registry uses this for per-stream ``top_k`` exports).
+
+    NaN-safe: non-finite values render as Prometheus' literal ``NaN`` /
+    ``+Inf`` / ``-Inf`` instead of crashing the scrape, and
+    :func:`parse_prometheus_text` round-trips them.
+    """
+    if not isinstance(values, Mapping) and hasattr(values, "export_values"):
+        values = values.export_values()
+    series: List[Tuple[Tuple[Tuple[str, str], ...], float]] = []
+    for job in sorted(values):
+        value = values[job]
+        base = (("job", str(job)),)
+        if isinstance(value, Mapping):
+            for comp in sorted(value):
+                series.append((base + (("component", str(comp)),), float(value[comp])))
+        elif isinstance(value, (list, tuple)):
+            for labels, v in value:
+                extra = tuple(sorted((str(k), str(lv)) for k, lv in dict(labels).items()))
+                series.append((base + extra, float(v)))
+        else:
+            series.append((base, float(value)))
+    if not series:
+        return ""
+    lines = [f"# TYPE {_METRIC_VALUE_GAUGE} gauge"]
+    for labels, v in series:
+        lines.append(f"{_METRIC_VALUE_GAUGE}{_prom_labels(labels)} {_gauge_fmt(v)}")
+    return "\n".join(lines) + "\n"
+
+
 def parse_prometheus_text(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
     """Parse exposition-format lines back into {(name, labels): value}.
 
@@ -188,6 +246,7 @@ def summarize_counters(
     streaming: Dict[str, float] = {}
     multistream: Dict[str, float] = {}
     ckpt: Dict[str, float] = {}
+    serve: Dict[str, float] = {}
     iou_hits = iou_misses = 0.0
     fallbacks = 0.0
     faults = 0.0
@@ -211,6 +270,9 @@ def summarize_counters(
         elif name.startswith("ckpt."):
             field = name[len("ckpt."):]
             ckpt[field] = ckpt.get(field, 0) + value
+        elif name.startswith("serve."):
+            field = name[len("serve."):]
+            serve[field] = serve.get(field, 0) + value
         elif name == "iou_cache.hits":
             iou_hits += value
         elif name == "iou_cache.misses":
@@ -235,6 +297,8 @@ def summarize_counters(
         out["multistream"] = {k: int(v) for k, v in sorted(multistream.items())}
     if ckpt:
         out["ckpt"] = {k: int(v) for k, v in sorted(ckpt.items())}
+    if serve:
+        out["serve"] = {k: int(v) for k, v in sorted(serve.items())}
     if iou_hits or iou_misses:
         out["iou_cache"] = {
             "hits": int(iou_hits),

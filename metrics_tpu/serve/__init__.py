@@ -1,0 +1,46 @@
+"""Durable streaming-eval service: the library as a long-running system.
+
+``metrics_tpu.serve`` turns a set of metrics into a process you can run for
+days: a bounded ingestion queue micro-batching records into static-shape
+compiled updates, a registry of named eval jobs (plain, windowed,
+time-decayed, multistream) with device-side queries, a stdlib HTTP surface
+(``/metrics``, ``/query``, ``/healthz``, ``POST /ingest``), and a
+durability loop taking preemption-safe checkpoints so a kill at any moment
+loses at most the unflushed tail.
+
+Quick start::
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.checkpoint import CheckpointManager
+    from metrics_tpu.serve import EvalServer, MetricRegistry, ServeConfig
+
+    registry = MetricRegistry()
+    registry.register("mse", MeanSquaredError())
+    manager = CheckpointManager("/ckpts/evals", max_staleness=30.0)
+    server = EvalServer(registry, ServeConfig(port=9100), manager).start()
+    server.submit("mse", (0.9, 1.0))
+    # GET :9100/metrics  |  GET :9100/query?job=mse  |  GET :9100/healthz
+    server.stop()        # drain + final checkpoint
+
+See ``docs/serving.md`` for the architecture and the soak/kill→restore
+drill that backs the durability claim.
+"""
+
+from metrics_tpu.serve.ingest import BlockBatcher, IngestConsumer, IngestQueue, Record
+from metrics_tpu.serve.registry import EvalJob, MetricRegistry
+from metrics_tpu.serve.server import EvalServer, ServeConfig
+from metrics_tpu.serve.traffic import JobTraffic, TrafficGenerator, default_traffic
+
+__all__ = [
+    "BlockBatcher",
+    "EvalJob",
+    "EvalServer",
+    "IngestConsumer",
+    "IngestQueue",
+    "JobTraffic",
+    "MetricRegistry",
+    "Record",
+    "ServeConfig",
+    "TrafficGenerator",
+    "default_traffic",
+]

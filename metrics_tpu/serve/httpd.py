@@ -1,0 +1,211 @@
+"""HTTP surface: stdlib ``http.server``, three read endpoints + ingest.
+
+No new dependencies and **no blocking collectives or KV waits on any
+request thread** — ``tools/serve_lint.py`` enforces that statically, and the
+registry's forced ``sync_on_compute=False`` enforces it dynamically.  The
+server is a ``ThreadingHTTPServer``: scrapes and queries stay responsive
+while the consumer thread dispatches blocks, because handlers only ever
+take a per-job lock around a local device read.
+
+Endpoints:
+
+* ``GET /healthz`` — liveness + queue depth + job inventory (JSON; 503 while
+  draining so load balancers stop routing before shutdown).
+* ``GET /metrics`` — Prometheus exposition: the runtime counters/spans from
+  ``obs.prometheus_text()`` **plus** computed metric values as gauges from
+  ``obs.metric_values_prometheus_text(registry)``.
+* ``GET /query`` — per-tenant reads: ``?job=NAME`` (full compute),
+  ``&streams=1,2,3`` (O(k) per-stream slice), ``&top_k=5[&largest=0]
+  [&key=...]`` (device-ranked), ``&where=gt:0.9&k=8`` (device-filtered).
+* ``POST /ingest`` — JSON records ``{"job": ..., "records": [{"values":
+  [...], "stream_id": ...}, ...]}``; full queues reject with 429.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from metrics_tpu.obs import core as _obs
+from metrics_tpu.obs.exporters import metric_values_prometheus_text, prometheus_text
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["ServeHTTPServer", "make_http_server"]
+
+_MAX_INGEST_BYTES = 8 << 20
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the owning EvalServer reference."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address: Tuple[str, int], eval_server: Any) -> None:
+        super().__init__(address, _Handler)
+        self.eval_server = eval_server
+
+
+def make_http_server(host: str, port: int, eval_server: Any) -> ServeHTTPServer:
+    """Bind the serve endpoints; ``port=0`` picks an ephemeral port."""
+    return ServeHTTPServer((host, port), eval_server)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "metrics-tpu-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------- plumbing
+    def log_message(self, fmt: str, *args: Any) -> None:
+        # request logging is the counters' job, not stderr's
+        pass
+
+    def _send(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: Dict[str, Any]) -> None:
+        self._send(status, json.dumps(payload).encode(), "application/json")
+
+    def _fail(self, status: int, message: str) -> None:
+        _obs.counter_inc("serve.http_errors", status=str(status))
+        self._send_json(status, {"error": message})
+
+    # ------------------------------------------------------------- routing
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        url = urlparse(self.path)
+        try:
+            if url.path == "/healthz":
+                self._healthz()
+            elif url.path == "/metrics":
+                self._metrics()
+            elif url.path == "/query":
+                self._query(parse_qs(url.query))
+            else:
+                self._fail(404, f"no route {url.path!r}")
+        except MetricsTPUUserError as err:
+            self._fail(400, str(err))
+        except BrokenPipeError:
+            pass
+        except Exception as err:  # one bad request must not kill the thread pool
+            self._fail(500, f"{type(err).__name__}: {err}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        try:
+            if url.path == "/ingest":
+                self._ingest()
+            else:
+                self._fail(404, f"no route {url.path!r}")
+        except MetricsTPUUserError as err:
+            self._fail(400, str(err))
+        except BrokenPipeError:
+            pass
+        except Exception as err:
+            self._fail(500, f"{type(err).__name__}: {err}")
+
+    # ------------------------------------------------------------ endpoints
+    def _healthz(self) -> None:
+        srv = self.server.eval_server
+        _obs.counter_inc("serve.healthz_requests")
+        payload = srv.health()
+        self._send_json(503 if payload["status"] == "draining" else 200, payload)
+
+    def _metrics(self) -> None:
+        srv = self.server.eval_server
+        _obs.counter_inc("serve.scrapes")
+        text = prometheus_text() + metric_values_prometheus_text(srv.registry)
+        self._send(200, text.encode(), "text/plain; version=0.0.4")
+
+    @staticmethod
+    def _one(params: Dict[str, List[str]], name: str) -> Optional[str]:
+        vals = params.get(name)
+        return vals[-1] if vals else None
+
+    def _query(self, params: Dict[str, List[str]]) -> None:
+        srv = self.server.eval_server
+        name = self._one(params, "job")
+        if not name:
+            raise MetricsTPUUserError("query needs ?job=NAME")
+        try:
+            job = srv.registry[name]
+        except KeyError as err:
+            self._fail(404, str(err))
+            return
+        _obs.counter_inc("serve.queries", job=name)
+        key: Any = self._one(params, "key")
+        if key is not None and key.lstrip("-").isdigit():
+            key = int(key)
+        out: Dict[str, Any] = {"job": name, "kind": job.kind}
+        streams = self._one(params, "streams")
+        top_k = self._one(params, "top_k")
+        where = self._one(params, "where")
+        from metrics_tpu.serve.registry import _to_jsonable  # local: no cycle at import
+
+        if streams is not None:
+            ids = [int(s) for s in streams.split(",") if s != ""]
+            out["streams"] = ids
+            out["values"] = _to_jsonable(job.compute_streams(ids))
+        elif top_k is not None:
+            largest = self._one(params, "largest") != "0"
+            values, ids = job.top_k(int(top_k), key=key, largest=largest)
+            out["top_k"] = _to_jsonable(values)
+            out["stream_ids"] = [int(i) for i in _as_int_list(ids)]
+            out["largest"] = largest
+        elif where is not None:
+            op, _, threshold = where.partition(":")
+            k = int(self._one(params, "k") or "16")
+            ids, total = job.where_op(op, float(threshold), k=k, key=key)
+            out["stream_ids"] = [i for i in _as_int_list(ids) if i >= 0]
+            out["total_matches"] = int(_scalar(total))
+        else:
+            out["value"] = _to_jsonable(job.compute())
+        self._send_json(200, out)
+
+    def _ingest(self) -> None:
+        srv = self.server.eval_server
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0 or length > _MAX_INGEST_BYTES:
+            raise MetricsTPUUserError(
+                f"ingest needs a JSON body of 1..{_MAX_INGEST_BYTES} bytes"
+            )
+        try:
+            payload = json.loads(self.rfile.read(length).decode())
+        except (ValueError, UnicodeDecodeError) as err:
+            raise MetricsTPUUserError(f"ingest body is not valid JSON: {err}")
+        name = payload.get("job")
+        records = payload.get("records")
+        if not isinstance(name, str) or not isinstance(records, list):
+            raise MetricsTPUUserError(
+                'ingest body must be {"job": NAME, "records": [...]}'
+            )
+        if name not in srv.registry:
+            self._fail(404, f"unknown job {name!r}")
+            return
+        accepted = rejected = 0
+        for rec in records:
+            values = rec.get("values")
+            if not isinstance(values, list) or not values:
+                raise MetricsTPUUserError('each record needs "values": [...]')
+            ok = srv.submit(name, tuple(values), stream_id=rec.get("stream_id"))
+            accepted += int(ok)
+            rejected += int(not ok)
+        status = 429 if rejected and not accepted else 200
+        self._send_json(status, {"accepted": accepted, "rejected": rejected})
+
+
+def _as_int_list(arr: Any) -> List[int]:
+    import numpy as np
+
+    return [int(v) for v in np.asarray(arr).reshape(-1)]
+
+
+def _scalar(value: Any) -> float:
+    import numpy as np
+
+    return float(np.asarray(value))

@@ -1,0 +1,254 @@
+"""EvalServer: the long-running process tying the serve pieces together.
+
+Thread layout (one process, no new dependencies):
+
+* **consumer** — the single writer; drains the :class:`IngestQueue` into
+  per-job :class:`BlockBatcher` dispatches (see ``ingest.py``).
+* **http** — a ``ThreadingHTTPServer`` answering ``/healthz``, ``/metrics``,
+  ``/query`` and ``POST /ingest``; read paths take per-job locks only.
+* **durability** (optional) — polls :meth:`CheckpointManager.save_due` and
+  snapshots the whole registry when the max-staleness budget runs out or an
+  operator armed :meth:`~CheckpointManager.request_save`.
+
+Lifecycle:
+
+* :meth:`start` restores from the newest committed checkpoint when one
+  exists (restore-on-start), then brings the threads up.
+* :meth:`stop` is the graceful path: mark draining (``/healthz`` flips to
+  503, new records are rejected), let the consumer drain the queue and
+  flush every partial block, take one final checkpoint, then shut the HTTP
+  server down.  A drained-and-stopped server loses nothing.
+* :meth:`kill` is the preemption drill: drop the queue and stop without a
+  final checkpoint — restart recovery is the durability loop's last commit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from metrics_tpu.checkpoint.manager import CheckpointManager
+from metrics_tpu.obs import core as _obs
+from metrics_tpu.serve.httpd import make_http_server
+from metrics_tpu.serve.ingest import IngestConsumer, IngestQueue, Record, _FlushToken
+from metrics_tpu.serve.registry import MetricRegistry
+from metrics_tpu.utils.exceptions import CheckpointError, MetricsTPUUserError
+
+__all__ = ["ServeConfig", "EvalServer"]
+
+
+@dataclass
+class ServeConfig:
+    """Knobs for one :class:`EvalServer`.
+
+    ``port=0`` binds an ephemeral port (read it back from
+    :attr:`EvalServer.port` — what the tests and the bench do).
+    ``flush_interval`` bounds ingest-to-state latency for partial blocks;
+    ``durability_poll`` bounds how stale past ``max_staleness`` a crash can
+    strand you, so keep it well under the manager's budget.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    queue_capacity: int = 4096
+    block_rows: int = 256
+    flush_interval: float = 0.05
+    poll_timeout: float = 0.02
+    drain_timeout: float = 30.0
+    durability_poll: float = 0.1
+
+
+class EvalServer:
+    """One registry + one queue + the three service threads."""
+
+    def __init__(
+        self,
+        registry: MetricRegistry,
+        config: Optional[ServeConfig] = None,
+        checkpoint_manager: Optional[CheckpointManager] = None,
+    ) -> None:
+        if len(registry) == 0:
+            raise MetricsTPUUserError("EvalServer needs at least one registered job")
+        self.registry = registry
+        self.config = config or ServeConfig()
+        self.manager = checkpoint_manager
+        self.queue = IngestQueue(capacity=self.config.queue_capacity)
+        self.consumer = IngestConsumer(
+            registry,
+            self.queue,
+            block_rows=self.config.block_rows,
+            flush_interval=self.config.flush_interval,
+            poll_timeout=self.config.poll_timeout,
+        )
+        self.last_checkpoint_step: Optional[int] = None
+        self.restored_step: Optional[int] = None
+        self._httpd = None
+        self._threads: Dict[str, threading.Thread] = {}
+        self._durability_stop = threading.Event()
+        self._draining = False
+        self._started = False
+        self._stopped = False
+        self._t0 = time.monotonic()
+        self._ckpt_lock = threading.Lock()  # serializes checkpoint_now callers
+
+    # ---------------------------------------------------------------- startup
+    def start(self) -> "EvalServer":
+        """Restore-on-start, then bring up consumer + HTTP (+ durability)."""
+        if self._started:
+            raise MetricsTPUUserError("EvalServer.start() called twice")
+        self._started = True
+        self._t0 = time.monotonic()
+        if self.manager is not None and self.manager.latest_step() is not None:
+            with self.registry.locked():
+                result = self.manager.restore(self.registry.checkpoint_target())
+            self.restored_step = self.last_checkpoint_step = result.step
+            _obs.counter_inc("serve.restores")
+        self._spawn("consumer", self.consumer.run)
+        self._httpd = make_http_server(self.config.host, self.config.port, self)
+        # a 0.1s shutdown-poll keeps stop()/kill() teardown snappy
+        self._spawn("http", lambda: self._httpd.serve_forever(poll_interval=0.1))
+        if self.manager is not None:
+            self._spawn("durability", self._durability_loop)
+        return self
+
+    def _spawn(self, name: str, fn: Any) -> None:
+        t = threading.Thread(target=fn, name=f"serve-{name}", daemon=True)
+        self._threads[name] = t
+        t.start()
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise MetricsTPUUserError("server is not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        if self._httpd is None:
+            raise MetricsTPUUserError("server is not started")
+        return (self.config.host, self.port)
+
+    # ---------------------------------------------------------------- ingest
+    def submit(
+        self,
+        job: str,
+        values: Tuple[Any, ...],
+        stream_id: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Enqueue one record; ``False`` when draining or the queue is full."""
+        if self._draining:
+            _obs.counter_inc("serve.records_rejected", reason="draining")
+            return False
+        return self.queue.put(Record(job, tuple(values), stream_id), timeout=timeout)
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Force every partial block into metric state and wait for it.
+
+        Round-trips a token through the queue while the consumer is alive
+        (so it serializes after everything already enqueued); falls back to
+        a direct flush once the consumer has exited.
+        """
+        consumer = self._threads.get("consumer")
+        if consumer is not None and consumer.is_alive():
+            token = _FlushToken()
+            self.queue.put_control(token)
+            return token.done.wait(timeout)
+        self.consumer.flush_all()
+        return True
+
+    # ------------------------------------------------------------ durability
+    def checkpoint_now(self, step: Optional[int] = None) -> int:
+        """Flush, quiesce every job, and commit one checkpoint."""
+        if self.manager is None:
+            raise MetricsTPUUserError("EvalServer has no CheckpointManager")
+        with self._ckpt_lock:
+            self.flush()
+            with self.registry.locked():
+                committed = self.manager.save_now(
+                    self.registry.checkpoint_target(), step=step
+                )
+            self.last_checkpoint_step = committed
+        _obs.counter_inc("serve.checkpoints")
+        return committed
+
+    def _durability_loop(self) -> None:
+        poll = self.config.durability_poll
+        while not self._durability_stop.wait(timeout=poll):
+            if not self.manager.save_due():
+                continue
+            try:
+                self.checkpoint_now()
+            except CheckpointError as err:
+                # a faulted store must not take the service down: count it,
+                # keep serving, retry on the next poll
+                _obs.counter_inc("serve.checkpoint_failures")
+                self.consumer.errors.append(f"checkpoint failed: {err}")
+
+    # ----------------------------------------------------------------- health
+    def health(self) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {
+            "status": "draining" if self._draining else "serving",
+            "uptime_secs": round(time.monotonic() - self._t0, 3),
+            "queue_depth": self.queue.depth(),
+            "records_ingested": sum(
+                job.records_ingested for job in self.registry.jobs()
+            ),
+            "jobs": self.registry.describe(),
+            "last_checkpoint_step": self.last_checkpoint_step,
+            "restored_step": self.restored_step,
+        }
+        if self.manager is not None:
+            payload["checkpoint_staleness_secs"] = round(self.manager.staleness(), 3)
+        return payload
+
+    # --------------------------------------------------------------- shutdown
+    def stop(self, final_checkpoint: bool = True) -> Optional[int]:
+        """Graceful drain: reject new records, flush everything buffered,
+        optionally commit a final checkpoint, then stop the threads.
+
+        Returns the final checkpoint step (``None`` when skipped)."""
+        if self._stopped:
+            return self.last_checkpoint_step if final_checkpoint else None
+        self._draining = True
+        # durability loop first, so the final save below cannot race it
+        self._stop_thread("durability", self._durability_stop.set)
+        self.consumer.stop.set()
+        self._stop_thread("consumer", None, timeout=self.config.drain_timeout)
+        committed = None
+        if final_checkpoint and self.manager is not None:
+            committed = self.checkpoint_now()
+            _obs.counter_inc("serve.drains")
+        self._teardown_http()
+        self._stopped = True
+        return committed
+
+    def kill(self) -> None:
+        """Preemption drill: stop NOW — drop the queue, skip the final
+        checkpoint.  Recovery is whatever the durability loop last committed."""
+        if self._stopped:
+            return
+        self._draining = True
+        self._stop_thread("durability", self._durability_stop.set)
+        self.consumer.kill.set()
+        self._stop_thread("consumer", None, timeout=5.0)
+        self._teardown_http()
+        self._stopped = True
+        _obs.counter_inc("serve.kills")
+
+    def _stop_thread(
+        self, name: str, signal: Optional[Any], timeout: float = 5.0
+    ) -> None:
+        t = self._threads.get(name)
+        if signal is not None:
+            signal()
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+
+    def _teardown_http(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._stop_thread("http", None)
+            self._httpd.server_close()

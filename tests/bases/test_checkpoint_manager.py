@@ -535,3 +535,74 @@ class TestStoreAtomicity:
     def test_manager_validates_policy(self, tmp_path):
         with pytest.raises(ValueError, match="on_restore_error"):
             CheckpointManager(str(tmp_path), on_restore_error="explode")
+
+
+class TestStalenessSeam:
+    """The serve durability loop's trigger surface: explicit ``save_now`` /
+    ``request_save`` plus the ``max_staleness`` cadence budget."""
+
+    def _target(self):
+        m = mt.MeanMetric()
+        m.update(1.0)
+        return m
+
+    def test_max_staleness_validated(self, tmp_path):
+        for bad in (0, -1.0):
+            with pytest.raises(ValueError, match="max_staleness"):
+                _mgr(tmp_path, max_staleness=bad)
+
+    def test_no_budget_never_due(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        assert mgr.max_staleness is None
+        assert not mgr.save_due()
+        assert mgr.seconds_until_due() is None
+        assert mgr.maybe_save(self._target()) is None
+        assert mgr.latest_step() is None
+
+    def test_staleness_budget_turns_due_and_save_resets_it(self, tmp_path):
+        import time
+
+        mgr = _mgr(tmp_path, max_staleness=0.05)
+        remaining = mgr.seconds_until_due()
+        assert remaining is not None and 0.0 <= remaining <= 0.05
+        time.sleep(0.06)
+        assert mgr.staleness() >= 0.05
+        assert mgr.save_due()
+        step = mgr.maybe_save(self._target())
+        assert step == 0
+        # the committed save restarted the budget
+        assert not mgr.save_due()
+        assert mgr.staleness() < 0.05
+        assert mgr.maybe_save(self._target()) is None
+
+    def test_request_save_arms_immediately(self, tmp_path):
+        mgr = _mgr(tmp_path, max_staleness=3600.0)
+        assert not mgr.save_due()
+        mgr.request_save()
+        assert mgr.save_due()
+        assert mgr.seconds_until_due() == 0.0
+        step = mgr.save_now(self._target())
+        assert step == 0
+        assert not mgr.save_due()  # save_now cleared the armed request
+
+    def test_restore_counts_as_durable(self, tmp_path):
+        import time
+
+        mgr = _mgr(tmp_path, max_staleness=0.05)
+        mgr.save(self._target())
+        time.sleep(0.06)
+        assert mgr.save_due()
+        mgr.restore(mt.MeanMetric())
+        # restored state IS the durable state: the budget restarts
+        assert not mgr.save_due()
+
+    def test_failed_save_keeps_the_trigger_armed(self, tmp_path):
+        store = ChaosStore(LocalStore(str(tmp_path)), faults=[("torn_write", "MANIFEST")])
+        mgr = CheckpointManager(store=store, rank=0, world_size=1, max_staleness=3600.0)
+        mgr.request_save()
+        with pytest.raises(CheckpointError):
+            mgr.save_now(self._target())
+        # the fault ate the commit; the request must survive for the retry
+        assert mgr.save_due()
+        assert mgr.save_now(self._target()) == 0
+        assert not mgr.save_due()

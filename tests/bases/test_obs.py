@@ -296,3 +296,61 @@ class TestSyncReportHistory:
         history = mc.sync_report_history
         assert set(history) == {"a", "b"}
         assert all(len(v) == 1 for v in history.values())
+
+
+class TestMetricValueGauges:
+    """The serve scrape surface: computed values as labeled gauges."""
+
+    def test_scalar_component_and_labeled_series_roundtrip(self):
+        text = obs.metric_values_prometheus_text(
+            {
+                "mse": 0.25,
+                "quantiles": {"p99": 2.5, "p50": 1.5},
+                "tenants": [({"stream": "3"}, 2.0), ({"stream": "9"}, 4.0)],
+            }
+        )
+        assert text.startswith("# TYPE metrics_tpu_metric_value gauge")
+        parsed = obs.parse_prometheus_text(text)
+        g = "metrics_tpu_metric_value"
+        assert parsed[(g, (("job", "mse"),))] == 0.25
+        assert parsed[(g, (("job", "quantiles"), ("component", "p50")))] == 1.5
+        assert parsed[(g, (("job", "quantiles"), ("component", "p99")))] == 2.5
+        assert parsed[(g, (("job", "tenants"), ("stream", "3")))] == 2.0
+        assert parsed[(g, (("job", "tenants"), ("stream", "9")))] == 4.0
+
+    def test_non_finite_values_are_nan_safe(self):
+        import math
+
+        text = obs.metric_values_prometheus_text(
+            {"a": float("nan"), "b": float("inf"), "c": float("-inf")}
+        )
+        parsed = obs.parse_prometheus_text(text)
+        g = "metrics_tpu_metric_value"
+        assert math.isnan(parsed[(g, (("job", "a"),))])
+        assert parsed[(g, (("job", "b"),))] == float("inf")
+        assert parsed[(g, (("job", "c"),))] == float("-inf")
+
+    def test_duck_types_export_values_objects(self):
+        class FakeRegistry:
+            def export_values(self):
+                return {"m": 1.0}
+
+        text = obs.metric_values_prometheus_text(FakeRegistry())
+        parsed = obs.parse_prometheus_text(text)
+        assert parsed[("metrics_tpu_metric_value", (("job", "m"),))] == 1.0
+
+    def test_empty_is_empty(self):
+        assert obs.metric_values_prometheus_text({}) == ""
+
+    def test_composes_with_counter_exposition(self):
+        obs.counter_inc("serve.scrapes")
+        text = obs.prometheus_text() + obs.metric_values_prometheus_text({"m": 0.5})
+        parsed = obs.parse_prometheus_text(text)
+        assert parsed[("metrics_tpu_serve_scrapes_total", ())] == 1
+        assert parsed[("metrics_tpu_metric_value", (("job", "m"),))] == 0.5
+
+    def test_summarize_counters_serve_bucket(self):
+        obs.counter_inc("serve.records_ingested", 42)
+        obs.counter_inc("serve.queries", job="mse")
+        summary = obs.summarize_counters()
+        assert summary["serve"] == {"records_ingested": 42, "queries": 1}

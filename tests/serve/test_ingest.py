@@ -1,0 +1,214 @@
+"""Ingestion pipeline: static-shape batching, padding exactness, back-pressure."""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from metrics_tpu.multistream import MultiStreamMetric
+from metrics_tpu.obs import counter_value
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.serve import (
+    BlockBatcher,
+    IngestConsumer,
+    IngestQueue,
+    MetricRegistry,
+    Record,
+)
+from metrics_tpu.serve.ingest import _FlushToken, _pow2_chunks
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _plain_registry():
+    reg = MetricRegistry()
+    reg.register("mse", MeanSquaredError())
+    return reg
+
+
+def _multi_registry(num_streams=8):
+    reg = MetricRegistry()
+    reg.register(
+        "tenants", MultiStreamMetric(MeanSquaredError(), num_streams=num_streams)
+    )
+    return reg
+
+
+class TestPow2Chunks:
+    @pytest.mark.parametrize("n", [0, 1, 2, 3, 7, 8, 9, 31, 32, 33, 100, 255, 257])
+    def test_covers_exactly_with_bounded_shape_set(self, n):
+        cap = 32
+        chunks = _pow2_chunks(n, cap)
+        assert sum(chunks) == n
+        assert all(c & (c - 1) == 0 and 0 < c <= cap for c in chunks)
+        # the whole point: at most log2(cap)+1 distinct shapes ever compiled
+        assert len(set(chunks)) <= int(math.log2(cap)) + 1
+
+
+class TestBlockBatcher:
+    def test_plain_batching_matches_direct_update(self):
+        reg = _plain_registry()
+        batcher = BlockBatcher(reg["mse"], block_rows=8)
+        rng = np.random.default_rng(0)
+        preds = rng.uniform(size=21).astype(np.float32)
+        target = rng.uniform(size=21).astype(np.float32)
+        for p, t in zip(preds, target):
+            batcher.add(Record("mse", (p, t)))
+        batcher.flush()
+
+        direct = MeanSquaredError()
+        direct.update(preds, target)
+        np.testing.assert_allclose(
+            np.asarray(reg["mse"].compute()), np.asarray(direct.compute()), rtol=1e-6
+        )
+        # 21 rows at cap 8 -> chunks 8+8+4+1 = four static-shape dispatches
+        assert reg["mse"].blocks_dispatched == 4
+        assert reg["mse"].records_ingested == 21
+
+    def test_multistream_padding_is_bit_exact(self):
+        """A short padded block computes bit-identically to the unpadded rows:
+        pad rows carry stream_id -1 and are dropped on device."""
+        S = 8
+        reg = _multi_registry(S)
+        batcher = BlockBatcher(reg["tenants"], block_rows=16)
+        rng = np.random.default_rng(1)
+        preds = rng.uniform(size=10).astype(np.float32)
+        target = rng.uniform(size=10).astype(np.float32)
+        ids = rng.integers(0, S, size=10).astype(np.int32)
+        for p, t, s in zip(preds, target, ids):
+            batcher.add(Record("tenants", (p, t), int(s)))
+        batcher.flush()
+        assert batcher.rows_padded == 6
+
+        direct = MultiStreamMetric(MeanSquaredError(), num_streams=S)
+        direct.update(preds, target, stream_ids=ids)
+        got = np.asarray(reg["tenants"].compute())
+        want = np.asarray(direct.compute())
+        assert got.shape == want.shape
+        assert np.all(got.view(np.uint32) == want.view(np.uint32))
+
+    def test_capacity_autoflush(self):
+        reg = _plain_registry()
+        batcher = BlockBatcher(reg["mse"], block_rows=4)
+        for i in range(4):
+            batcher.add(Record("mse", (np.float32(i), np.float32(0))))
+        # hit capacity -> flushed without an explicit call
+        assert len(batcher) == 0
+        assert reg["mse"].records_ingested == 4
+
+    def test_validation(self):
+        reg = _plain_registry()
+        mreg = _multi_registry()
+        with pytest.raises(MetricsTPUUserError, match="power of two"):
+            BlockBatcher(reg["mse"], block_rows=12)
+        with pytest.raises(MetricsTPUUserError, match="stream_id"):
+            BlockBatcher(mreg["tenants"]).add(Record("tenants", (1.0, 2.0)))
+        with pytest.raises(MetricsTPUUserError, match="stream_id must be None"):
+            BlockBatcher(reg["mse"]).add(Record("mse", (1.0, 2.0), stream_id=3))
+        with pytest.raises(MetricsTPUUserError, match="mixed arity"):
+            b = BlockBatcher(reg["mse"])
+            b.add(Record("mse", (1.0, 2.0)))
+            b.add(Record("mse", (1.0,)))
+            b.flush()
+
+
+class TestIngestQueue:
+    def test_bounded_rejection_is_counted(self):
+        q = IngestQueue(capacity=3)
+        rec = Record("mse", (1.0, 2.0))
+        before = counter_value("serve.records_rejected")
+        assert all(q.put(rec) for _ in range(3))
+        assert q.put(rec) is False
+        assert q.depth() == 3
+        assert counter_value("serve.records_rejected") == before + 1
+
+    def test_get_timeout_returns_none(self):
+        assert IngestQueue(capacity=2).get(timeout=0.01) is None
+
+
+class TestIngestConsumer:
+    def _run_consumer(self, registry, consumer_kwargs=None):
+        q = IngestQueue(capacity=1024)
+        consumer = IngestConsumer(registry, q, **(consumer_kwargs or {}))
+        thread = threading.Thread(target=consumer.run, daemon=True)
+        thread.start()
+        return q, consumer, thread
+
+    def test_routes_flushes_and_drains(self):
+        reg = _plain_registry()
+        q, consumer, thread = self._run_consumer(
+            reg, {"block_rows": 8, "flush_interval": 3600.0}
+        )
+        rng = np.random.default_rng(2)
+        preds = rng.uniform(size=5).astype(np.float32)
+        target = rng.uniform(size=5).astype(np.float32)
+        for p, t in zip(preds, target):
+            assert q.put(Record("mse", (p, t)))
+        # a flush token serializes after the 5 records and forces the
+        # partial block out
+        token = _FlushToken()
+        q.put_control(token)
+        assert token.done.wait(10.0)
+        direct = MeanSquaredError()
+        direct.update(preds, target)
+        np.testing.assert_allclose(
+            np.asarray(reg["mse"].compute()), np.asarray(direct.compute()), rtol=1e-6
+        )
+        consumer.stop.set()
+        thread.join(timeout=10.0)
+        assert not thread.is_alive()
+
+    def test_unroutable_and_malformed_are_counted_not_fatal(self):
+        reg = _plain_registry()
+        before_unroutable = counter_value("serve.records_unroutable")
+        before_malformed = counter_value("serve.records_malformed")
+        q, consumer, thread = self._run_consumer(reg)
+        q.put(Record("nope", (1.0, 2.0)))
+        q.put(Record("mse", (1.0, 2.0), stream_id=5))  # plain job, has stream_id
+        q.put(Record("mse", (np.float32(1.0), np.float32(2.0))))  # still served
+        token = _FlushToken()
+        q.put_control(token)
+        assert token.done.wait(10.0)
+        consumer.stop.set()
+        thread.join(timeout=10.0)
+        assert counter_value("serve.records_unroutable") == before_unroutable + 1
+        assert counter_value("serve.records_malformed") == before_malformed + 1
+        assert reg["mse"].records_ingested == 1
+        assert len(consumer.errors) == 2
+
+    def test_kill_drops_the_queue(self):
+        reg = _plain_registry()
+        q, consumer, thread = self._run_consumer(
+            reg, {"block_rows": 64, "flush_interval": 3600.0}
+        )
+        for _ in range(10):
+            q.put(Record("mse", (np.float32(0.5), np.float32(0.25))))
+        token = _FlushToken()
+        q.put_control(token)
+        assert token.done.wait(10.0)
+        ingested_at_kill = reg["mse"].records_ingested
+        for _ in range(7):  # these may or may not be consumed, never flushed
+            q.put(Record("mse", (np.float32(0.5), np.float32(0.25))))
+        consumer.kill.set()
+        thread.join(timeout=10.0)
+        # killed: no final flush, so nothing past the token's flush landed
+        assert reg["mse"].records_ingested == ingested_at_kill
+
+
+class TestTrafficDeterminism:
+    def test_record_is_pure_in_seed_and_index(self):
+        from metrics_tpu.serve import JobTraffic, TrafficGenerator
+
+        specs = [
+            JobTraffic("a", arity=2),
+            JobTraffic("b", arity=1, num_streams=4, oob_every=5),
+        ]
+        t1 = TrafficGenerator(specs, seed=3)
+        t2 = TrafficGenerator(specs, seed=3)
+        # random access == replay: record i never depends on draw history
+        replayed = list(t2.replay(0, 40))
+        for i in reversed(range(40)):
+            a, b = t1.record(i), replayed[i]
+            assert a.job == b.job and a.stream_id == b.stream_id
+            assert all(float(x) == float(y) for x, y in zip(a.values, b.values))
+        assert any(r.stream_id is not None and r.stream_id >= 4 for r in replayed)

@@ -1,0 +1,169 @@
+"""Registry semantics: registration invariants, queries, exports, durability."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanMetric, obs
+from metrics_tpu.multistream import MultiStreamMetric
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.serve import MetricRegistry
+from metrics_tpu.streaming import StreamingQuantile, TimeDecayedMetric, WindowedMetric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _registry(num_streams=8):
+    reg = MetricRegistry()
+    reg.register("mse", MeanSquaredError())
+    reg.register(
+        "tenants",
+        MultiStreamMetric(MeanSquaredError(), num_streams=num_streams),
+        export_top_k=2,
+    )
+    return reg
+
+
+class TestRegistration:
+    def test_forces_local_read_paths(self):
+        metric = MeanSquaredError(sync_on_compute=True, dist_sync_on_step=True)
+        reg = MetricRegistry()
+        reg.register("m", metric)
+        assert metric.sync_on_compute is False
+        assert metric.dist_sync_on_step is False
+
+    def test_rejects_duplicates_and_bad_names(self):
+        reg = _registry()
+        with pytest.raises(MetricsTPUUserError, match="already registered"):
+            reg.register("mse", MeanSquaredError())
+        for bad in ("", "-leading", 'sp ace', 'quo"te'):
+            with pytest.raises(MetricsTPUUserError, match="not a valid label"):
+                reg.register(bad, MeanSquaredError())
+        with pytest.raises(MetricsTPUUserError, match="Metric instance"):
+            reg.register("notametric", object())
+
+    def test_kind_detection(self):
+        reg = _registry()
+        reg.register("w", WindowedMetric(MeanSquaredError(), window_size=3))
+        reg.register("d", TimeDecayedMetric(MeanSquaredError(), half_life=10.0))
+        kinds = {name: reg[name].kind for name in reg}
+        assert kinds == {
+            "mse": "plain",
+            "tenants": "multistream",
+            "w": "windowed",
+            "d": "time_decayed",
+        }
+
+    def test_dict_protocol(self):
+        reg = _registry()
+        assert "mse" in reg and "nope" not in reg
+        assert len(reg) == 2
+        with pytest.raises(KeyError, match="registered"):
+            reg["nope"]
+
+
+class TestQueries:
+    def test_multistream_query_paths(self):
+        reg = _registry(num_streams=8)
+        job = reg["tenants"]
+        preds = np.asarray([0.0, 0.0, 1.0, 1.0], np.float32)
+        target = np.asarray([0.0, 1.0, 0.0, 1.0], np.float32)
+        ids = np.asarray([0, 1, 2, 3], np.int32)
+        job.metric.update(preds, target, stream_ids=ids)
+
+        per_stream = np.asarray(job.compute_streams([0, 1, 2, 3]))
+        np.testing.assert_allclose(per_stream, [0.0, 1.0, 1.0, 0.0])
+
+        values, top_ids = job.top_k(2)
+        assert sorted(int(i) for i in np.asarray(top_ids)) == [1, 2]
+        np.testing.assert_allclose(np.asarray(values), [1.0, 1.0])
+
+        hit_ids, total = job.where_op("ge", 1.0, k=4)
+        matched = [int(i) for i in np.asarray(hit_ids) if int(i) >= 0]
+        assert sorted(matched) == [1, 2]
+        assert int(np.asarray(total)) == 2
+
+    def test_query_guards(self):
+        reg = _registry()
+        with pytest.raises(MetricsTPUUserError, match="MultiStreamMetric job"):
+            reg["mse"].compute_streams([0])
+        with pytest.raises(MetricsTPUUserError, match="MultiStreamMetric job"):
+            reg["mse"].top_k(2)
+        with pytest.raises(MetricsTPUUserError, match="unknown where-op"):
+            reg["tenants"].where_op("contains", 0.5, k=2)
+        with pytest.raises(MetricsTPUUserError, match="only windowed jobs"):
+            reg["mse"].advance_window()
+
+
+class TestExports:
+    def test_scalar_and_component_exports(self):
+        reg = MetricRegistry()
+        reg.register("mse", MeanSquaredError())
+        reg.register(
+            "q", StreamingQuantile(q=(0.5, 0.99)), components=("p50", "p99")
+        )
+        reg["mse"].metric.update(
+            np.asarray([1.0, 0.0], np.float32), np.asarray([0.0, 0.0], np.float32)
+        )
+        reg["q"].metric.update(np.arange(100, dtype=np.float32))
+        values = reg.export_values()
+        assert values["mse"] == pytest.approx(0.5)
+        assert set(values["q"]) == {"p50", "p99"}
+
+    def test_component_name_arity_checked(self):
+        reg = MetricRegistry()
+        reg.register("q", StreamingQuantile(q=(0.5, 0.9, 0.99)), components=("a", "b"))
+        reg["q"].metric.update(np.arange(10, dtype=np.float32))
+        with pytest.raises(MetricsTPUUserError, match="component name"):
+            reg["q"].export_values()
+
+    def test_multistream_export_is_bounded(self):
+        reg = _registry(num_streams=8)
+        job = reg["tenants"]
+        job.metric.update(
+            np.asarray([1.0, 0.0], np.float32),
+            np.asarray([0.0, 0.0], np.float32),
+            stream_ids=np.asarray([3, 5], np.int32),
+        )
+        out = job.export_values()
+        labels = [dict(lbl) for lbl, _v in out]
+        assert {"component": "active_streams"} in labels
+        assert {"component": "dropped_rows"} in labels
+        streams = [lbl["stream"] for lbl in labels if "stream" in lbl]
+        assert len(streams) == 2  # export_top_k, never all 8 streams
+        rendered = obs.metric_values_prometheus_text(reg)
+        parsed = obs.parse_prometheus_text(rendered)
+        assert (
+            "metrics_tpu_metric_value",
+            (("job", "tenants"), ("component", "active_streams")),
+        ) in parsed
+
+
+class TestDurability:
+    def test_checkpoint_target_keeps_jobs_independent(self):
+        reg = MetricRegistry()
+        reg.register("a", MeanSquaredError())
+        reg.register("b", MeanSquaredError())
+        target = reg.checkpoint_target()
+        reg["a"].metric.update(
+            np.asarray([1.0], np.float32), np.asarray([0.0], np.float32)
+        )
+        # compute_groups=False: identical-schema tenants must never alias
+        assert float(np.asarray(reg["a"].metric.sum_squared_error)) == 1.0
+        assert float(np.asarray(reg["b"].metric.sum_squared_error)) == 0.0
+        assert target is reg.checkpoint_target()  # cached
+        reg.register("c", MeanMetric())
+        assert target is not reg.checkpoint_target()  # invalidated on register
+
+    def test_checkpoint_target_empty_registry_raises(self):
+        with pytest.raises(MetricsTPUUserError, match="empty registry"):
+            MetricRegistry().checkpoint_target()
+
+    def test_locked_takes_and_releases_every_job(self):
+        reg = _registry()
+        with reg.locked():
+            for job in reg.jobs():
+                # RLock: re-acquire from the owning thread succeeds
+                assert job.lock.acquire(blocking=False)
+                job.lock.release()
+        for job in reg.jobs():
+            assert job.lock.acquire(blocking=False)
+            job.lock.release()

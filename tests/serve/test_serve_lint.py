@@ -1,0 +1,59 @@
+"""Tier-1 gate: serve request paths never spell a blocking collective/KV wait."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "tools"))
+
+from serve_lint import LINTED_MODULES, lint, lint_source  # noqa: E402
+
+
+def test_request_paths_are_collective_free():
+    assert lint() == []
+
+
+def test_lint_covers_the_request_path_modules():
+    covered = {os.path.basename(m) for m in LINTED_MODULES}
+    assert {"httpd.py", "ingest.py", "registry.py", "traffic.py"} <= covered
+
+
+def test_lint_source_flags_blocking_calls():
+    src = "\n".join(
+        [
+            "def handler(metric, client, backend):",
+            "    metric.sync(backend=backend)",
+            "    client.blocking_key_value_get('k', 1000)",
+            "    backend.psum(1.0)",
+            "    backend.wait_at_barrier('b')",
+            "    mgr.save(target)",
+        ]
+    )
+    problems = lint_source(src, "synthetic.py")
+    flagged = "\n".join(problems)
+    for name in ("sync", "blocking_key_value_get", "psum", "wait_at_barrier", "save"):
+        assert f"`{name}(...)`" in flagged
+    assert len(problems) == 5
+
+
+def test_lint_source_flags_banned_imports():
+    for src in (
+        "from metrics_tpu.parallel import LoopbackBackend",
+        "import metrics_tpu.checkpoint",
+        "from metrics_tpu.checkpoint.manager import CheckpointManager",
+        "from jax.experimental.multihost_utils import sync_global_devices",
+    ):
+        problems = lint_source(src, "synthetic.py")
+        assert problems and "must stay out of request-path modules" in problems[0]
+
+
+def test_lint_source_allows_local_reads():
+    src = "\n".join(
+        [
+            "import numpy as np",
+            "from metrics_tpu.obs import core as _obs",
+            "def read(job):",
+            "    with job.lock:",
+            "        return np.asarray(job.metric.compute())",
+        ]
+    )
+    assert lint_source(src, "synthetic.py") == []

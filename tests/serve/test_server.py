@@ -1,0 +1,250 @@
+"""EvalServer end-to-end: HTTP surface, restore-on-start, drain, mini drill."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from metrics_tpu.checkpoint import CheckpointManager
+from metrics_tpu.multistream import MultiStreamMetric
+from metrics_tpu.obs import parse_prometheus_text
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.serve import EvalServer, MetricRegistry, ServeConfig
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+S = 8
+
+
+def _registry():
+    reg = MetricRegistry()
+    reg.register("mse", MeanSquaredError())
+    reg.register(
+        "tenants", MultiStreamMetric(MeanSquaredError(), num_streams=S), export_top_k=2
+    )
+    return reg
+
+
+def _config(**kw):
+    kw.setdefault("block_rows", 16)
+    kw.setdefault("flush_interval", 3600.0)  # flushes in tests are explicit
+    return ServeConfig(**kw)
+
+
+def _get(port, path, expect=200):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10.0) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as err:
+        assert err.code == expect, f"{path}: HTTP {err.code}: {err.read()!r}"
+        return err.code, err.read()
+
+
+def _get_json(port, path, expect=200):
+    status, body = _get(port, path, expect=expect)
+    assert status == expect, f"{path}: HTTP {status}: {body!r}"
+    return json.loads(body)
+
+
+def _post_json(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+@pytest.fixture
+def server():
+    srv = EvalServer(_registry(), _config()).start()
+    yield srv
+    if not srv._stopped:
+        srv.kill()
+
+
+def _feed(srv, n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = rng.uniform(size=n).astype(np.float32)
+    target = rng.uniform(size=n).astype(np.float32)
+    for p, t in zip(preds, target):
+        assert srv.submit("mse", (p, t), timeout=5.0)
+        assert srv.submit(
+            "tenants", (p, t), stream_id=int(rng.integers(0, S)), timeout=5.0
+        )
+    assert srv.flush()
+    return preds, target
+
+
+class TestHTTPSurface:
+    def test_healthz(self, server):
+        _feed(server, n=5)
+        payload = _get_json(server.port, "/healthz")
+        assert payload["status"] == "serving"
+        assert payload["records_ingested"] == 10
+        assert {j["job"] for j in payload["jobs"]} == {"mse", "tenants"}
+        assert payload["last_checkpoint_step"] is None
+
+    def test_metrics_exposes_counters_and_value_gauges(self, server):
+        _feed(server, n=5)
+        status, body = _get(server.port, "/metrics")
+        assert status == 200
+        parsed = parse_prometheus_text(body.decode())
+        assert parsed[
+            ("metrics_tpu_serve_records_ingested_total", ())
+        ] >= 10
+        gauge_jobs = {
+            dict(labels).get("job")
+            for (name, labels) in parsed
+            if name == "metrics_tpu_metric_value"
+        }
+        assert {"mse", "tenants"} <= gauge_jobs
+
+    def test_query_plain_and_multistream(self, server):
+        preds, target = _feed(server, n=8)
+        direct = MeanSquaredError()
+        direct.update(preds, target)
+        out = _get_json(server.port, "/query?job=mse")
+        assert out["kind"] == "plain"
+        assert out["value"] == pytest.approx(float(np.asarray(direct.compute())), rel=1e-6)
+
+        streams = _get_json(server.port, "/query?job=tenants&streams=0,1")
+        assert streams["streams"] == [0, 1] and len(streams["values"]) == 2
+
+        top = _get_json(server.port, "/query?job=tenants&top_k=2")
+        assert len(top["top_k"]) == 2 and len(top["stream_ids"]) == 2
+
+        hits = _get_json(server.port, "/query?job=tenants&where=ge:0.0&k=8")
+        assert hits["total_matches"] >= 1
+
+    def test_query_errors(self, server):
+        _get_json(server.port, "/query", expect=400)
+        _get_json(server.port, "/query?job=nope", expect=404)
+        _get_json(server.port, "/query?job=mse&top_k=2", expect=400)
+        _get_json(server.port, "/nosuch", expect=404)
+
+    def test_ingest_post_roundtrip(self, server):
+        status, out = _post_json(
+            server.port,
+            "/ingest",
+            {
+                "job": "mse",
+                "records": [{"values": [1.0, 0.0]}, {"values": [0.0, 0.0]}],
+            },
+        )
+        assert status == 200 and out == {"accepted": 2, "rejected": 0}
+        assert server.flush()
+        got = _get_json(server.port, "/query?job=mse")
+        assert got["value"] == pytest.approx(0.5)
+
+    def test_ingest_post_validation(self, server):
+        status, out = _post_json(server.port, "/ingest", {"job": "nope", "records": []})
+        assert status == 404
+        status, out = _post_json(server.port, "/ingest", {"records": "x"})
+        assert status == 400 and "error" in out
+
+
+class TestLifecycle:
+    def test_start_twice_raises(self, server):
+        with pytest.raises(MetricsTPUUserError, match="twice"):
+            server.start()
+
+    def test_restore_on_start(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        srv = EvalServer(_registry(), _config(), mgr).start()
+        try:
+            preds, target = _feed(srv, n=6, seed=3)
+            step = srv.checkpoint_now()
+        finally:
+            srv.kill()
+
+        mgr2 = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        srv2 = EvalServer(_registry(), _config(), mgr2).start()
+        try:
+            assert srv2.restored_step == step
+            direct = MeanSquaredError()
+            direct.update(preds, target)
+            got = np.asarray(srv2.registry["mse"].compute())
+            assert np.all(
+                got.astype(np.float64).view(np.uint64)
+                == np.asarray(direct.compute(), np.float64).view(np.uint64)
+            )
+            health = _get_json(srv2.port, "/healthz")
+            assert health["restored_step"] == step
+        finally:
+            srv2.kill()
+
+    def test_drain_stop_flushes_and_checkpoints(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        srv = EvalServer(_registry(), _config(), mgr).start()
+        # a partial block, never explicitly flushed: the graceful drain must
+        # not lose it
+        assert srv.submit("mse", (np.float32(1.0), np.float32(0.0)), timeout=5.0)
+        final = srv.stop(final_checkpoint=True)
+        assert final is not None
+        assert srv.submit("mse", (1.0, 0.0)) is False  # draining rejects
+
+        mgr2 = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        reg2 = _registry()
+        result = mgr2.restore(reg2.checkpoint_target(), step=final)
+        assert result.step == final
+        assert float(np.asarray(reg2["mse"].compute())) == pytest.approx(1.0)
+
+    def test_kill_skips_final_checkpoint(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), rank=0, world_size=1)
+        srv = EvalServer(_registry(), _config(), mgr).start()
+        assert srv.submit("mse", (np.float32(1.0), np.float32(0.0)), timeout=5.0)
+        srv.kill()
+        assert mgr.latest_step() is None
+
+    def test_durability_loop_max_staleness(self, tmp_path):
+        mgr = CheckpointManager(
+            str(tmp_path), rank=0, world_size=1, max_staleness=0.2
+        )
+        srv = EvalServer(
+            _registry(), _config(durability_poll=0.05), mgr
+        ).start()
+        try:
+            _feed(srv, n=3, seed=5)
+            deadline = __import__("time").monotonic() + 10.0
+            while srv.last_checkpoint_step is None:
+                assert __import__("time").monotonic() < deadline, (
+                    "durability loop never checkpointed"
+                )
+                __import__("time").sleep(0.05)
+            assert mgr.latest_step() is not None
+        finally:
+            srv.stop(final_checkpoint=False)
+
+
+class TestMiniDrill:
+    @pytest.mark.slow
+    def test_kill_restore_recovers_bit_identical(self, tmp_path):
+        """Miniature of the soak drill: checkpoint, lose a tail, kill,
+        restore, replay — byte-for-byte equal to never having died.
+        Slow-tier: five jobs' worth of compiles; the tier-1 restore story
+        is covered by ``TestLifecycle.test_restore_on_start``."""
+        from metrics_tpu.serve.soak import run_drill
+
+        result = run_drill(
+            str(tmp_path),
+            n=180,
+            k=100,
+            lost_tail=7,
+            block_rows=16,
+            num_streams=8,
+            store_faults=[],
+            poll=False,
+        )
+        assert result.identical, {
+            "baseline": result.baseline,
+            "recovered": result.recovered,
+        }
+        assert result.restored_step == result.checkpoint_step
+        assert result.checkpoint_failures == 0

@@ -1,0 +1,41 @@
+"""Slow tier: the full soak drill — faults injected, service stays live,
+kill→restore recovers bit-identically.  Run with ``-m slow``."""
+
+import pytest
+
+from metrics_tpu.obs import counter_value
+from metrics_tpu.serve.soak import run_drill
+
+
+@pytest.mark.slow
+def test_soak_drill_under_faults(tmp_path):
+    failures_before = counter_value("serve.checkpoint_failures")
+    result = run_drill(
+        str(tmp_path),
+        n=1500,
+        k=900,
+        lost_tail=15,
+        block_rows=64,
+        store_faults=[("torn_write", "MANIFEST")],
+        poll=True,
+    )
+
+    # the durability claim: recovery is bit-identical to never dying
+    assert result.identical, {
+        "baseline": result.baseline,
+        "recovered": result.recovered,
+    }
+    assert result.restored_step == result.checkpoint_step
+    assert result.final_step is not None
+
+    # the chaos actually fired and the service rode it out
+    assert ("torn_write", "step_00000000/MANIFEST.json") in result.chaos_injected
+    assert result.checkpoint_failures >= 1
+    assert counter_value("serve.checkpoint_failures") >= failures_before + 1
+    assert result.sync_report.get("fallback") == "local"
+    assert result.sync_report.get("faults_injected")
+
+    # the HTTP surface never went dark: every poll in both phases got a 2xx
+    assert result.poller_failures == []
+    assert result.poller_summary["phase1"]["requests"] > 0
+    assert result.poller_summary["phase2"]["requests"] > 0

@@ -15,7 +15,7 @@ def test_streaming_modules_are_shape_static():
 
 def test_lint_covers_multistream():
     covered = {os.path.basename(d) for d in LINTED_DIRS}
-    assert {"streaming", "multistream"} <= covered
+    assert {"streaming", "multistream", "serve"} <= covered
 
 
 def test_lint_source_flags_dynamic_shapes():

@@ -28,6 +28,7 @@ DOMAINS = [
     ("streaming", "Streaming"),
     ("multistream", "Multistream"),
     ("checkpoint", "Checkpoint"),
+    ("serve", "Serve"),
 ]
 
 OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "api")

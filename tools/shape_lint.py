@@ -41,6 +41,9 @@ STREAMING_DIR = os.path.join(_REPO_ROOT, "metrics_tpu", "streaming")
 LINTED_DIRS = (
     STREAMING_DIR,
     os.path.join(_REPO_ROOT, "metrics_tpu", "multistream"),
+    # the serving path dispatches compiled blocks: the same static-shape
+    # discipline applies to everything between the queue and the metric
+    os.path.join(_REPO_ROOT, "metrics_tpu", "serve"),
 )
 
 # call names whose result shape depends on data values
@@ -135,7 +138,7 @@ def main() -> int:
     if problems:
         print(f"shape_lint: {len(problems)} violation(s)", file=sys.stderr)
         return 1
-    print("shape_lint: streaming/ and multistream/ state is shape-static")
+    print("shape_lint: streaming/, multistream/ and serve/ state is shape-static")
     return 0
 
 
