@@ -81,7 +81,11 @@ class MultiStreamMetric(Metric):
     update arguments where every array leaf carries a leading row axis, plus
     an integer ``stream_ids`` vector assigning each row to a stream.  Rows
     with ids outside ``[0, num_streams)`` are dropped (counted in the
-    ``stream_dropped`` state).  ``compute()`` returns the base metric's
+    ``stream_dropped`` state).  ``update(..., num_valid=k)`` additionally
+    declares rows past index ``k`` to be padding: they neither route nor
+    count as dropped, so fixed-capacity callers can pad short blocks to a
+    static shape without inflating the drop signal (pass ``k`` as a size-1
+    integer array — a traced value — so varying fills never retrace).  ``compute()`` returns the base metric's
     value per stream, stacked on a leading ``(num_streams, ...)`` axis;
     streams that never received a row compute whatever the base metric
     yields on default state (typically NaN).
@@ -241,6 +245,7 @@ class MultiStreamMetric(Metric):
     def _pre_update(self, *args: Any, **kwargs: Any) -> None:
         kwargs = dict(kwargs)
         stream_ids = kwargs.pop("stream_ids", None)
+        self._check_num_valid(kwargs.pop("num_valid", None))
         self._check_update_inputs(stream_ids, args, kwargs)
         # eager mode-locking etc. happens on the base with concrete inputs
         self._base._pre_update(*args, **kwargs)
@@ -248,7 +253,25 @@ class MultiStreamMetric(Metric):
             "multistream.scatter_updates", metric=type(self._base).__name__
         )
 
-    def update(self, *args: Any, stream_ids: Any = None, **kwargs: Any) -> None:
+    @staticmethod
+    def _check_num_valid(num_valid: Any) -> Optional[Array]:
+        """Static (trace-safe) validation of the ``num_valid`` row count."""
+        if num_valid is None:
+            return None
+        nv = jnp.ravel(jnp.asarray(num_valid))
+        if not jnp.issubdtype(nv.dtype, jnp.integer):
+            raise MetricsTPUUserError(
+                f"num_valid must be an integer row count, got dtype {nv.dtype}"
+            )
+        if nv.size != 1:
+            raise MetricsTPUUserError(
+                f"num_valid must be a single row count, got shape {nv.shape}"
+            )
+        return nv[0].astype(jnp.int32)
+
+    def update(
+        self, *args: Any, stream_ids: Any = None, num_valid: Any = None, **kwargs: Any
+    ) -> None:
         ids, leaves, treedef, is_batched, statics, n = self._check_update_inputs(
             stream_ids, args, kwargs
         )
@@ -265,13 +288,30 @@ class MultiStreamMetric(Metric):
         valid = (ids >= 0) & (ids < S)
         # out-of-range rows route to segment S, which every scatter drops
         ids_safe = jnp.where(valid, ids, S)
-        if self._strategy == "segment":
-            self._segment_update(ids_safe, valid, batched, _rebuild, n)
+        # num_valid declares the tail rows past it to be padding: they never
+        # route AND never count as dropped, so fixed-capacity callers (the
+        # serve BlockBatcher) can pad short blocks without corrupting the
+        # dropped-row signal.  A traced scalar, so it never retraces.
+        nv = self._check_num_valid(num_valid)
+        if nv is not None:
+            n_real = jnp.clip(nv, 0, n)
+            valid = valid & (jnp.arange(n, dtype=jnp.int32) < n_real)
+            ids_safe = jnp.where(valid, ids_safe, S)
         else:
-            self._vmap_update(ids_safe, valid, batched, _rebuild, n)
+            n_real = n
+        if self._strategy == "segment":
+            self._segment_update(ids_safe, valid, batched, _rebuild, n, n_real)
+        else:
+            self._vmap_update(ids_safe, valid, batched, _rebuild, n, n_real)
 
     def _segment_update(
-        self, ids_safe: Array, valid: Array, batched: tuple, _rebuild: Callable, n: int
+        self,
+        ids_safe: Array,
+        valid: Array,
+        batched: tuple,
+        _rebuild: Callable,
+        n: int,
+        n_real: Any,
     ) -> None:
         S = self.num_streams
         default_state = self._base.init_state()
@@ -302,7 +342,7 @@ class MultiStreamMetric(Metric):
                 self._state[name] = jnp.minimum(live, seg.astype(live.dtype))
         self._state[self._ROWS_STATE] = self._state[self._ROWS_STATE] + counts
         self._state[self._DROPPED_STATE] = self._state[self._DROPPED_STATE] + (
-            n - counts.sum()
+            n_real - counts.sum()
         ).astype(jnp.int32)
 
     def _rows_capacity(self, n: int) -> int:
@@ -311,7 +351,13 @@ class MultiStreamMetric(Metric):
         return min(n, max(8, -(-4 * n // self.num_streams)))
 
     def _vmap_update(
-        self, ids_safe: Array, valid: Array, batched: tuple, _rebuild: Callable, n: int
+        self,
+        ids_safe: Array,
+        valid: Array,
+        batched: tuple,
+        _rebuild: Callable,
+        n: int,
+        n_real: Any,
     ) -> None:
         S = self.num_streams
         m = self._rows_capacity(n)
@@ -344,7 +390,7 @@ class MultiStreamMetric(Metric):
         )
         self._state[self._ROWS_STATE] = self._state[self._ROWS_STATE] + counts
         self._state[self._DROPPED_STATE] = self._state[self._DROPPED_STATE] + (
-            n - counts.sum()
+            n_real - counts.sum()
         ).astype(jnp.int32)
 
     # ----------------------------------------------------------------- compute

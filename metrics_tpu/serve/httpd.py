@@ -114,7 +114,9 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server.eval_server
         _obs.counter_inc("serve.healthz_requests")
         payload = srv.health()
-        self._send_json(503 if payload["status"] == "draining" else 200, payload)
+        # anything but "serving" (draining, failed writer) is a 503 so load
+        # balancers stop routing to a server that cannot apply records
+        self._send_json(200 if payload["status"] == "serving" else 503, payload)
 
     def _metrics(self) -> None:
         srv = self.server.eval_server
@@ -187,12 +189,29 @@ class _Handler(BaseHTTPRequestHandler):
         if name not in srv.registry:
             self._fail(404, f"unknown job {name!r}")
             return
-        accepted = rejected = 0
-        for rec in records:
+        # validate the WHOLE batch before enqueuing any of it: a malformed
+        # record mid-list must 400 with nothing accepted, not after earlier
+        # records already landed with no accounting of which ones
+        parsed: List[Tuple[Tuple[Any, ...], Optional[int]]] = []
+        for i, rec in enumerate(records):
+            if not isinstance(rec, dict):
+                raise MetricsTPUUserError(
+                    f"record {i} must be a JSON object, got {type(rec).__name__}"
+                )
             values = rec.get("values")
             if not isinstance(values, list) or not values:
-                raise MetricsTPUUserError('each record needs "values": [...]')
-            ok = srv.submit(name, tuple(values), stream_id=rec.get("stream_id"))
+                raise MetricsTPUUserError(f'record {i} needs "values": [...]')
+            stream_id = rec.get("stream_id")
+            if stream_id is not None and (
+                isinstance(stream_id, bool) or not isinstance(stream_id, int)
+            ):
+                raise MetricsTPUUserError(
+                    f'record {i} has a non-integer "stream_id": {stream_id!r}'
+                )
+            parsed.append((tuple(values), stream_id))
+        accepted = rejected = 0
+        for values, stream_id in parsed:
+            ok = srv.submit(name, values, stream_id=stream_id)
             accepted += int(ok)
             rejected += int(not ok)
         status = 429 if rejected and not accepted else 200

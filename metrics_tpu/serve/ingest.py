@@ -8,10 +8,12 @@ state math, applied to the serving path:
 
 * **Multistream jobs** fill fixed-capacity padded blocks: rows stack into a
   ``(block_rows, ...)`` block, short blocks pad with zero rows whose
-  ``stream_id`` is ``-1`` — out of range, so the scatter drops them on
-  device and the padding provably never touches metric state.  Every block
-  is ONE compiled ``update`` with ONE shape, so the jitted program never
-  retraces no matter how traffic arrives.
+  ``stream_id`` is ``-1`` and a ``num_valid`` row count — pad rows neither
+  route on device nor count into the metric's ``dropped_rows`` signal, so
+  padding provably never touches metric state *or* its drop accounting.
+  Every block is ONE compiled ``update`` with ONE shape (``num_valid``
+  rides along as a traced scalar), so the jitted program never retraces no
+  matter how traffic arrives.
 * **Plain jobs** (no stream routing, so no drop lane to pad into) decompose
   each flush into power-of-two chunks capped at ``block_rows``: at most
   ``log2(block_rows)+1`` distinct shapes ever reach the compiler, and every
@@ -82,10 +84,19 @@ class IngestQueue:
             _obs.counter_inc("serve.records_rejected")
             return False
 
-    def put_control(self, token: _FlushToken) -> None:
-        """Control tokens (flush sentinels) may block: they are rare and the
-        caller is waiting on the round-trip anyway."""
-        self._q.put(token)
+    def put_control(self, token: _FlushToken, timeout: Optional[float] = None) -> bool:
+        """Enqueue a control token (flush sentinel).  Untimed by default —
+        tokens are rare and the caller is waiting on the round-trip anyway —
+        but callers that must re-check consumer liveness (a dead writer
+        never drains a full queue) pass ``timeout`` and retry on ``False``."""
+        try:
+            if timeout is None:
+                self._q.put(token)
+            else:
+                self._q.put(token, timeout=timeout)
+        except queue.Full:
+            return False
+        return True
 
     def get(self, timeout: float) -> Any:
         try:
@@ -176,10 +187,14 @@ class BlockBatcher:
                     for c in cols
                 ]
                 # -1 is out of [0, num_streams): the on-device scatter drops
-                # the pad rows, so short blocks stay bit-exact
+                # the pad rows, so short blocks stay bit-exact; num_valid
+                # (a size-1 array, so it traces instead of retracing per
+                # fill) keeps them out of the dropped_rows accounting too
                 id_col = np.full((self.block_rows,), -1, np.int32)
                 id_col[:n] = np.asarray(ids, np.int32)
-                self.job.metric.update(*padded, stream_ids=id_col)
+                self.job.metric.update(
+                    *padded, stream_ids=id_col, num_valid=np.asarray([n], np.int32)
+                )
                 self.rows_padded += pad
                 if pad:
                     _obs.counter_inc("serve.rows_padded", pad)
@@ -204,7 +219,16 @@ class IngestConsumer:
     than this flushes even though it is not full.  ``run`` exits when
     ``stop`` is set AND the queue has drained (graceful) or immediately on
     ``kill`` (preemption drill).
+
+    Untrusted rows cannot kill the writer: anything a record raises while
+    batching or dispatching (bad dtypes, ragged nested shapes, a stream_id
+    that is not an int, ...) is counted, logged, and dropped — the offending
+    record (or at worst its buffered batch) is lost, the thread and every
+    other job keep going.  A writer that dies anyway (a bug, not bad input)
+    is surfaced through ``EvalServer.health()``'s ``consumer_alive``.
     """
+
+    _MAX_ERRORS = 100  # keep the first N messages; count the rest
 
     def __init__(
         self,
@@ -216,6 +240,7 @@ class IngestConsumer:
     ) -> None:
         self.registry = registry
         self.queue = ingest_queue
+        self.block_rows = int(block_rows)
         self.flush_interval = float(flush_interval)
         self.poll_timeout = float(poll_timeout)
         self.batchers: Dict[str, BlockBatcher] = {
@@ -224,9 +249,39 @@ class IngestConsumer:
         self.stop = threading.Event()  # graceful: drain, then exit
         self.kill = threading.Event()  # preemption: exit now, drop the queue
         self.errors: List[str] = []
+        self.errors_total = 0
+
+    def record_error(self, message: str) -> None:
+        """Append to the bounded error log (a malformed-record flood must
+        not grow host memory without limit in a long-running service)."""
+        self.errors_total += 1
+        if len(self.errors) < self._MAX_ERRORS:
+            self.errors.append(message)
 
     def flush_all(self) -> int:
-        return sum(b.flush() for b in self.batchers.values())
+        """Flush every batcher.  A batch that fails to dispatch is dropped
+        and counted — it must not wedge the writer or starve other jobs."""
+        total = 0
+        for batcher in self.batchers.values():
+            try:
+                total += batcher.flush()
+            except Exception as err:  # noqa: BLE001 — untrusted rows reach np.stack/update
+                _obs.counter_inc("serve.flush_failures", job=batcher.job.name)
+                self.record_error(
+                    f"flush of job {batcher.job.name!r} dropped a batch: "
+                    f"{type(err).__name__}: {err}"
+                )
+        return total
+
+    def _batcher_for(self, name: str) -> Optional[BlockBatcher]:
+        batcher = self.batchers.get(name)
+        if batcher is None and name in self.registry:
+            # a job registered after the consumer came up still routes —
+            # only this (consumer) thread ever mutates the batcher map
+            batcher = self.batchers[name] = BlockBatcher(
+                self.registry[name], block_rows=self.block_rows
+            )
+        return batcher
 
     def _consume(self, item: Any, last_flush: float, now: float) -> float:
         if isinstance(item, _FlushToken):
@@ -234,13 +289,18 @@ class IngestConsumer:
             item.done.set()
             return now
         try:
-            self.batchers[item.job].add(item)
-        except KeyError:
-            _obs.counter_inc("serve.records_unroutable")
-            self.errors.append(f"unknown job {item.job!r}")
+            batcher = self._batcher_for(item.job)
+            if batcher is None:
+                _obs.counter_inc("serve.records_unroutable")
+                self.record_error(f"unknown job {item.job!r}")
+                return last_flush
+            batcher.add(item)
         except MetricsTPUUserError as err:
             _obs.counter_inc("serve.records_malformed")
-            self.errors.append(str(err))
+            self.record_error(str(err))
+        except Exception as err:  # noqa: BLE001 — POST /ingest data is untrusted
+            _obs.counter_inc("serve.records_malformed")
+            self.record_error(f"{type(err).__name__}: {err}")
         return last_flush
 
     def run(self) -> None:

@@ -149,13 +149,21 @@ class EvalServer:
 
         Round-trips a token through the queue while the consumer is alive
         (so it serializes after everything already enqueued); falls back to
-        a direct flush once the consumer has exited.
+        a direct flush once the consumer has exited.  Every wait is timed
+        and liveness is re-checked between them: a writer that dies with the
+        queue full makes this return ``False`` within ``timeout`` instead of
+        blocking forever on an enqueue nothing will ever drain.
         """
+        deadline = time.monotonic() + float(timeout)
+        token = _FlushToken()
         consumer = self._threads.get("consumer")
-        if consumer is not None and consumer.is_alive():
-            token = _FlushToken()
-            self.queue.put_control(token)
-            return token.done.wait(timeout)
+        while consumer is not None and consumer.is_alive():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.0:
+                return False
+            if self.queue.put_control(token, timeout=min(0.5, remaining)):
+                return token.done.wait(max(0.0, deadline - time.monotonic()))
+        # the single writer has exited: flushing inline cannot race it
         self.consumer.flush_all()
         return True
 
@@ -165,7 +173,13 @@ class EvalServer:
         if self.manager is None:
             raise MetricsTPUUserError("EvalServer has no CheckpointManager")
         with self._ckpt_lock:
-            self.flush()
+            if not self.flush():
+                # still a consistent snapshot, just missing buffered rows —
+                # commit it, but loudly: silent staleness is the real bug
+                _obs.counter_inc("serve.checkpoint_flush_timeouts")
+                self.consumer.record_error(
+                    "checkpoint flush timed out; snapshot misses buffered rows"
+                )
             with self.registry.locked():
                 committed = self.manager.save_now(
                     self.registry.checkpoint_target(), step=step
@@ -185,12 +199,24 @@ class EvalServer:
                 # a faulted store must not take the service down: count it,
                 # keep serving, retry on the next poll
                 _obs.counter_inc("serve.checkpoint_failures")
-                self.consumer.errors.append(f"checkpoint failed: {err}")
+                self.consumer.record_error(f"checkpoint failed: {err}")
 
     # ----------------------------------------------------------------- health
     def health(self) -> Dict[str, Any]:
+        consumer = self._threads.get("consumer")
+        consumer_alive = bool(consumer is not None and consumer.is_alive())
+        if self._draining:
+            status = "draining"
+        elif self._started and not consumer_alive:
+            # the writer died: records pile up and silently go nowhere, so
+            # /healthz must stop saying "serving" (load balancers route on it)
+            status = "failed"
+        else:
+            status = "serving"
         payload: Dict[str, Any] = {
-            "status": "draining" if self._draining else "serving",
+            "status": status,
+            "consumer_alive": consumer_alive,
+            "consumer_errors": self.consumer.errors_total,
             "uptime_secs": round(time.monotonic() - self._t0, 3),
             "queue_depth": self.queue.depth(),
             "records_ingested": sum(

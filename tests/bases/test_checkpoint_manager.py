@@ -390,7 +390,10 @@ class TestElasticRestore:
             ref["q"].update(jnp.asarray(x))
         return cols, _computes(ref)
 
+    @pytest.mark.slow
     def test_shrink_two_to_one_folds_extra_shard(self, tmp_path):
+        # same merge_state fold path as the slow-tier 3->2 shrink drill;
+        # ~24s of sketch updates keeps it out of the tier-1 wall budget
         cols, ref = self._world_data(world=2)
         _save_world(tmp_path, cols)
 

@@ -231,10 +231,13 @@ class TestBackboneShapes:
     @pytest.mark.parametrize(
         "tap,dim",
         [
-            ("64", 64),
+            # one tap stays tier-1 as the representative (each parametrization
+            # rebuilds the Inception backbone, ~20s+ apiece on CPU); the rest
+            # run with the slow tier alongside logits/fast-path coverage
+            pytest.param("64", 64, marks=pytest.mark.slow),
             ("192", 192),
             pytest.param("768", 768, marks=pytest.mark.slow),
-            ("2048", 2048),
+            pytest.param("2048", 2048, marks=pytest.mark.slow),
         ],
     )
     def test_inception_taps(self, tap, dim):

@@ -86,6 +86,12 @@ class TestBlockBatcher:
         want = np.asarray(direct.compute())
         assert got.shape == want.shape
         assert np.all(got.view(np.uint32) == want.view(np.uint32))
+        # num_valid keeps the 6 pad rows out of the drop signal...
+        assert reg["tenants"].metric.dropped_rows() == 0
+        # ...while a genuinely out-of-range client row still counts
+        batcher.add(Record("tenants", (np.float32(0.5), np.float32(0.5)), S))
+        batcher.flush()
+        assert reg["tenants"].metric.dropped_rows() == 1
 
     def test_capacity_autoflush(self):
         reg = _plain_registry()
@@ -124,6 +130,13 @@ class TestIngestQueue:
 
     def test_get_timeout_returns_none(self):
         assert IngestQueue(capacity=2).get(timeout=0.01) is None
+
+    def test_put_control_timeout_returns_false_when_full(self):
+        q = IngestQueue(capacity=1)
+        assert q.put(Record("mse", (1.0, 2.0)))
+        # a dead writer never drains a full queue; the timed put lets the
+        # caller re-check liveness instead of blocking forever
+        assert q.put_control(_FlushToken(), timeout=0.05) is False
 
 
 class TestIngestConsumer:
@@ -175,6 +188,52 @@ class TestIngestConsumer:
         assert counter_value("serve.records_malformed") == before_malformed + 1
         assert reg["mse"].records_ingested == 1
         assert len(consumer.errors) == 2
+
+    def test_untrusted_rows_cannot_kill_the_writer(self):
+        """The review scenario: a non-int stream_id and ragged nested shapes
+        raise ValueError (not MetricsTPUUserError) — the writer must count
+        and drop them, not die while /healthz keeps saying 'serving'."""
+        reg = _plain_registry()
+        reg.register("tenants", MultiStreamMetric(MeanSquaredError(), num_streams=4))
+        before_malformed = counter_value("serve.records_malformed")
+        before_flush_fail = counter_value("serve.flush_failures", job="mse")
+        q, consumer, thread = self._run_consumer(
+            reg, {"block_rows": 8, "flush_interval": 3600.0}
+        )
+        # non-int stream_id: int("oops") raises ValueError inside add()
+        q.put(Record("tenants", (1.0, 2.0), "oops"))
+        # ragged nested shapes: np.stack raises ValueError at flush
+        q.put(Record("mse", (np.zeros(2, np.float32), np.zeros(2, np.float32))))
+        q.put(Record("mse", (np.zeros(3, np.float32), np.zeros(3, np.float32))))
+        token = _FlushToken()
+        q.put_control(token)
+        assert token.done.wait(10.0)
+        assert thread.is_alive()
+        # the writer keeps serving well-formed records afterwards
+        q.put(Record("mse", (np.float32(1.0), np.float32(0.0))))
+        token = _FlushToken()
+        q.put_control(token)
+        assert token.done.wait(10.0)
+        consumer.stop.set()
+        thread.join(timeout=10.0)
+        assert counter_value("serve.records_malformed") == before_malformed + 1
+        assert counter_value("serve.flush_failures", job="mse") == before_flush_fail + 1
+        assert reg["mse"].records_ingested == 1
+        assert consumer.errors_total == 2
+
+    def test_late_registered_job_is_routed(self):
+        reg = _plain_registry()
+        q, consumer, thread = self._run_consumer(reg, {"flush_interval": 3600.0})
+        # register AFTER the consumer snapshotted its batchers
+        late = reg.register("late_mse", MeanSquaredError())
+        q.put(Record("late_mse", (np.float32(1.0), np.float32(0.0))))
+        token = _FlushToken()
+        q.put_control(token)
+        assert token.done.wait(10.0)
+        consumer.stop.set()
+        thread.join(timeout=10.0)
+        assert late.records_ingested == 1
+        assert "late_mse" in consumer.batchers
 
     def test_kill_drops_the_queue(self):
         reg = _plain_registry()

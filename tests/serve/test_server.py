@@ -149,6 +149,68 @@ class TestHTTPSurface:
         status, out = _post_json(server.port, "/ingest", {"records": "x"})
         assert status == 400 and "error" in out
 
+    def test_ingest_post_is_atomic_on_malformed_batches(self, server):
+        # a bad record mid-list must reject the WHOLE batch up front — never
+        # accept a prefix and then 400 with no accounting
+        status, out = _post_json(
+            server.port,
+            "/ingest",
+            {"job": "mse", "records": [{"values": [1.0, 0.0]}, {"values": "x"}]},
+        )
+        assert status == 400 and "record 1" in out["error"]
+        # a non-dict record is a 400, not an AttributeError 500
+        status, out = _post_json(
+            server.port, "/ingest", {"job": "mse", "records": [42]}
+        )
+        assert status == 400 and "record 0" in out["error"]
+        # a non-integer stream_id is rejected at the HTTP edge
+        status, out = _post_json(
+            server.port,
+            "/ingest",
+            {"job": "tenants", "records": [{"values": [1.0, 0.0], "stream_id": "x"}]},
+        )
+        assert status == 400 and "stream_id" in out["error"]
+        assert server.queue.depth() == 0  # nothing partially enqueued
+
+
+class TestWriterFailure:
+    def test_healthz_flips_to_failed_when_writer_dies(self, server):
+        server.consumer.kill.set()
+        server._threads["consumer"].join(timeout=10.0)
+        payload = server.health()
+        assert payload["status"] == "failed"
+        assert payload["consumer_alive"] is False
+        _get_json(server.port, "/healthz", expect=503)
+
+    def test_flush_times_out_instead_of_hanging(self):
+        """The TOCTOU hazard: a writer that passes the liveness check, then
+        wedges with the queue full — flush() must return False within its
+        timeout, not block forever (it runs under the checkpoint lock)."""
+        import time
+
+        srv = EvalServer(_registry(), _config(queue_capacity=2)).start()
+        try:
+            srv.consumer.kill.set()
+            real = srv._threads["consumer"]
+            real.join(timeout=10.0)
+
+            class _Stuck:
+                def is_alive(self):
+                    return True
+
+                def join(self, timeout=None):
+                    pass
+
+            srv._threads["consumer"] = _Stuck()
+            assert srv.submit("mse", (1.0, 2.0))
+            assert srv.submit("mse", (1.0, 2.0))  # queue now full
+            t0 = time.monotonic()
+            assert srv.flush(timeout=0.6) is False
+            assert time.monotonic() - t0 < 5.0
+            srv._threads["consumer"] = real
+        finally:
+            srv.kill()
+
 
 class TestLifecycle:
     def test_start_twice_raises(self, server):
