@@ -1373,6 +1373,18 @@ def main() -> None:
     obs_totals = _obs_delta({}, _obs_counters())
     if obs_totals:
         extra["obs_totals"] = obs_totals
+    try:
+        # static-analysis gate telemetry: whether the tree is clean under
+        # python -m tools.analyze and how much is baselined, per pass
+        from tools.analyze import run_passes as _analyze_run
+
+        _rep = _analyze_run()
+        extra["analyze_findings_total"] = len(_rep.findings)
+        extra["analyze_baselined_total"] = len(_rep.baselined)
+        for _pname, _counts in sorted(_rep.per_pass.items()):
+            extra[f"analyze_{_pname.replace('-', '_')}_findings"] = _counts["findings"]
+    except Exception as err:  # never let the gate break the bench line
+        extra["analyze_findings_total"] = f"error: {type(err).__name__}: {err}"
     record = {
         "metric": "accuracy_updates_per_sec",
         "value": round(fused, 1),

@@ -128,6 +128,8 @@ class SumMetric(BaseAggregator):
         6.0
     """
 
+    stackable = True  # one zero-default sum state
+
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="sum_value", **kwargs)
 
@@ -139,6 +141,8 @@ class SumMetric(BaseAggregator):
 
 class CatMetric(BaseAggregator):
     """Concatenate everything (reference ``aggregation.py:246``)."""
+
+    stackable = False  # the concatenation list grows with the stream
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -166,6 +170,8 @@ class MeanMetric(BaseAggregator):
         >>> float(metric.compute())
         2.0
     """
+
+    stackable = True  # zero-default sum states (value, weight)
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="mean_value", **kwargs)

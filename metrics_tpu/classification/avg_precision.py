@@ -30,6 +30,7 @@ class AveragePrecision(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    stackable = False  # buffer states (preds/target) grow with the stream
     jit_compute_default = False
 
     def __init__(

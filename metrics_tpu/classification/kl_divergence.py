@@ -17,6 +17,7 @@ class KLDivergence(Metric):
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
+    stackable = False  # non-probabilistic mode holds a growing list state
 
     def __init__(
         self,

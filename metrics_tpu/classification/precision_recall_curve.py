@@ -24,6 +24,7 @@ class PrecisionRecallCurve(Metric):
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    stackable = False  # buffer states (preds/target) grow with the stream
     jit_compute_default = False  # host-side curve sweep (dynamic output length)
 
     def __init__(

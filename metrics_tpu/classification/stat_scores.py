@@ -50,6 +50,7 @@ class StatScores(Metric):
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    stackable = True  # tensor sum states only; per-stream stacking is exact
     # with validate_args=False, re-run value-level case detection after this
     # many fingerprint-matched (skipped) batches
     _REDETECT_EVERY = 64

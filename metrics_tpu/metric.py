@@ -283,6 +283,12 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
+    # Multistream stackability contract: True promises every state has a
+    # fixed-shape per-stream stacked form (tensor/sketch states only — the
+    # state-contract analysis pass enforces this statically), False marks a
+    # metric whose growing list/buffer state can never stack (MultiStreamMetric
+    # rejects it at construction), None makes no claim (runtime checks decide).
+    stackable: Optional[bool] = None
     # class-level jit policy; metrics with host-side (string/dict) inputs override
     jit_update_default: bool = True
     jit_compute_default: bool = True

@@ -141,6 +141,12 @@ class MultiStreamMetric(Metric):
             )
         if isinstance(base, MultiStreamMetric):
             raise MetricsTPUUserError("MultiStreamMetric cannot nest another MultiStreamMetric")
+        if base.stackable is False:
+            raise MetricsTPUUserError(
+                f"{type(base).__name__} declares stackable=False: its growing "
+                "list/buffer state has no fixed-shape per-stream stacked form; "
+                "wrap a stackable metric (tensor/sketch states) instead"
+            )
         self.num_streams = int(num_streams)
         if self.num_streams < 1:
             raise ValueError(f"num_streams must be >= 1, got {num_streams}")

@@ -45,6 +45,7 @@ class RetrievalMetric(Metric):
     is_differentiable: bool = False
     higher_is_better: bool = True
     full_state_update: bool = False
+    stackable = False  # buffer states (indexes/preds/target) grow with the stream
     jit_compute_default = False  # host-orchestrated: calls the jitted engine itself
     _empty_kind = "positive"  # which missing target class makes a query "empty"
 

@@ -21,6 +21,9 @@ Lifecycle:
 * :meth:`kill` is the preemption drill: drop the queue and stop without a
   final checkpoint — restart recovery is the durability loop's last commit.
 """
+# analyze: skip-file[serve-blocking] -- this module IS the durability layer:
+# it owns the checkpoint imports and the save/restore calls that the
+# request-path modules (httpd/ingest/registry/traffic) are banned from making.
 
 from __future__ import annotations
 

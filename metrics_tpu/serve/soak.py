@@ -22,6 +22,9 @@ Used by ``tests/serve/test_soak.py`` (slow tier) and, in miniature, by the
 fast server tests; ``python -m metrics_tpu.serve.soak`` runs the drill
 standalone.
 """
+# analyze: skip-file[serve-blocking] -- the soak harness is an operator/test
+# driver, not a request path: it deliberately wires checkpoint stores, chaos
+# backends, and explicit operator syncs around the server.
 
 from __future__ import annotations
 

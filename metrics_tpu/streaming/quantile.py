@@ -44,6 +44,7 @@ class SketchMetric(Metric):
 
     is_differentiable = False
     higher_is_better = None
+    stackable = True  # fixed-shape sketch state; streams stack on the vmap path
 
     def __init__(
         self,

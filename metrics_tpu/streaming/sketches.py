@@ -101,7 +101,7 @@ def _compact_level(buf, cnt, nc, rbit, h):
     picks = srow[rbit + 2 * jnp.arange(half)]
     n_surv = jnp.maximum((cnt[h] + 1 - rbit) // 2, 0).astype(jnp.int32)
     picks = jnp.where(jnp.arange(half) < n_surv, picks, _INF)
-    if h + 1 < levels:
+    if h + 1 < levels:  # analyze: ignore[trace-safety] -- h is a static Python level index (host-unrolled loop in _fold_chunks)
         # space is guaranteed: levels are compacted top-down, so h+1 already
         # holds at most capacity - half entries when h spills into it
         nxt = lax.dynamic_update_slice(buf[h + 1], picks, (cnt[h + 1],))

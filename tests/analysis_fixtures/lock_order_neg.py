@@ -1,0 +1,34 @@
+# lock-order negatives: 0 findings expected
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+class Worker:
+    def __init__(self, q, done):
+        self.lock = threading.Lock()
+        self.q = q
+        self.done = done
+
+    def fine_timed(self):
+        with self.lock:
+            return self.q.get(timeout=1.0)  # bounded wait is fine
+
+    def fine_release_first(self):
+        with self.lock:
+            item = self.q.get_nowait()
+        self.q.put(item, timeout=0.5)  # blocking op outside the lock
+        self.done.wait(2.0)  # timed wait, no lock held
+
+
+def consistent_one():
+    with a_lock:
+        with b_lock:  # always a_lock -> b_lock
+            return 1
+
+
+def consistent_two():
+    with a_lock:
+        with b_lock:
+            return 2

@@ -1,0 +1,35 @@
+# lock-order positives: 3 findings expected
+# (blocking-under-lock, blocking-callee-under-lock, inconsistent-order)
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+class Worker:
+    def __init__(self, q):
+        self.lock = threading.Lock()
+        self.q = q
+
+    def bad_block(self):
+        with self.lock:
+            return self.q.get()  # blocking-under-lock: untimed queue get
+
+    def _slow(self):
+        self.q.put(object())  # untimed put: this function blocks
+
+    def bad_callee(self):
+        with self.lock:
+            self._slow()  # blocking-callee-under-lock (one-hop propagation)
+
+
+def path_one():
+    with a_lock:
+        with b_lock:  # edge a_lock -> b_lock
+            return 1
+
+
+def path_two():
+    with b_lock:
+        with a_lock:  # edge b_lock -> a_lock: inconsistent-order 2-cycle
+            return 2

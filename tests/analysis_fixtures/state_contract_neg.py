@@ -1,0 +1,24 @@
+# state-contract negatives: 0 findings expected
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.streaming.kll import kll_init, kll_merge
+
+
+class GoodDefaults(Metric):
+    stackable = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.zeros((4,)), dist_reduce_fx="sum")
+        self.add_state("peak", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.add_state("floor", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_sketch_state("sk", kll_init(), kll_merge)
+
+
+class GoodList(Metric):
+    stackable = False  # growing list state, honestly annotated
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("rows", [], dist_reduce_fx="cat")

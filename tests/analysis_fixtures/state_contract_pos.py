@@ -1,0 +1,22 @@
+# state-contract positives: 5 findings expected
+# (reduce-default x2, list-state-reduce, sketch-merge, stackable-growing-state)
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+
+class BadDefaults(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.ones((4,)), dist_reduce_fx="sum")  # reduce-default
+        self.add_state("peak", jnp.asarray(jnp.inf), dist_reduce_fx="max")  # reduce-default
+        self.add_state("rows", [], dist_reduce_fx="sum")  # list-state-reduce
+        self.add_sketch_state("sk", {"leaf": jnp.zeros(8)}, None)  # sketch-merge
+
+
+class BadStackable(Metric):
+    stackable = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_buffer_state("preds")  # stackable-growing-state

@@ -1,0 +1,14 @@
+# suppression-marker fixture: both violations below are silenced
+# (line ignore for trace-safety, and the whole file opts out of shape-static)
+# analyze: skip-file[shape-static] -- fixture: exercises the file opt-out
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pulled(x):
+    return x.sum().item()  # analyze: ignore[trace-safety] -- fixture: exercises the line ignore
+
+
+def dynamic(x):
+    return jnp.nonzero(x)  # silenced by the skip-file marker above

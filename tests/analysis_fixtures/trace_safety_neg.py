@@ -1,0 +1,37 @@
+# trace-safety negatives: 0 findings expected
+from functools import partial
+
+import jax
+import jax.numpy as np  # ALIASED jax.numpy: asarray here is device-side
+
+
+@jax.jit
+def fine_alias(x):
+    return np.asarray(x) * 2  # np is jax.numpy — import graph must know
+
+
+@jax.jit
+def fine_static(x):
+    n = float(x.shape[0])  # shape reads are static under trace
+    if x is None:  # `is None` is a static predicate
+        return n
+    return n + int(len(x.shape))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def fine_static_argnames(x, k):
+    if k > 2:  # k is pinned static by the decorator
+        return x * k
+    return float(k) + x.sum()
+
+
+def eager_helper(values):
+    # not reachable from any trace wrapper: host casts are fine here
+    return [float(v) for v in values]
+
+
+@jax.jit
+def fine_mode(x, mode):
+    if mode == "sum":  # string compare: mode dispatch resolved at trace time
+        return x.sum()
+    return x.mean()

@@ -1,0 +1,27 @@
+# trace-safety positives: 4 findings expected
+# (host-pull, host-cast, numpy-in-trace, traced-branch)
+import jax
+import jax.numpy as jnp
+import numpy as np  # REAL numpy under the usual jax alias style
+
+
+@jax.jit
+def bad_pull(x):
+    return x.sum().item()  # host-pull
+
+
+@jax.jit
+def bad_cast(x):
+    return float(x + 1.0)  # host-cast: x is arrayish
+
+
+@jax.jit
+def bad_numpy(x):
+    return np.asarray(x) * 2  # numpy-in-trace: np IS host numpy here
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # traced-branch
+        return x
+    return -x

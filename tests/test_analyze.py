@@ -1,0 +1,381 @@
+"""Tier-1 gate: the unified static-analysis engine (``python -m tools.analyze``).
+
+Consolidates the four legacy lint gates (shape, serve, obs, ckpt) into one
+registry-driven suite: the repo must be clean under every registered pass,
+each pass must flag its fixture with an exact finding count, discovery must
+pick up new modules without a hand-maintained list, and the suppression
+layers (inline markers + baseline.json) must round-trip.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analyze import (  # noqa: E402
+    BASELINE_PATH,
+    PASSES,
+    analyze_source,
+    discover_units,
+    load_baseline,
+    run_passes,
+    update_baseline,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+
+AST_PASSES = ["shape-static", "serve-blocking", "trace-safety", "lock-order", "state-contract"]
+
+# state-contract's finish() imports the live library (slow in a cold
+# subprocess); the CLI tests only need passes that stay pure-AST — the
+# in-process gate above covers every pass.
+CLI_PASSES = ["shape-static", "serve-blocking", "trace-safety", "lock-order"]
+
+
+def _fixture(name: str) -> str:
+    return (FIXTURES / name).read_text()
+
+
+# ---------------------------------------------------------------------------
+# the repo-clean gate: every pass over the whole package, committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_under_every_pass():
+    report = run_passes()
+    assert report.ok, "\n".join(f.render() for f in report.findings)
+    assert report.findings == []
+    assert report.modules_analyzed > 150
+    assert set(report.per_pass) == set(PASSES)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_has_all_passes():
+    assert set(PASSES) >= {
+        "shape-static",
+        "serve-blocking",
+        "obs-instrumentation",
+        "ckpt-serializers",
+        "trace-safety",
+        "lock-order",
+        "state-contract",
+    }
+    assert len(PASSES) >= 7
+
+
+@pytest.mark.parametrize("name", sorted(PASSES))
+def test_pass_metadata(name):
+    p = PASSES[name]
+    assert p.name == name
+    assert p.description
+    assert p.severity in ("error", "warning")
+    assert p.kind in ("ast", "dynamic")
+
+
+# ---------------------------------------------------------------------------
+# fixtures: exact finding counts per pass
+# ---------------------------------------------------------------------------
+
+FIXTURE_CASES = [
+    ("trace_safety_pos.py", "trace-safety", 4,
+     {"host-pull", "host-cast", "numpy-in-trace", "traced-branch"}),
+    ("trace_safety_neg.py", "trace-safety", 0, set()),
+    ("lock_order_pos.py", "lock-order", 3,
+     {"blocking-under-lock", "blocking-callee-under-lock", "inconsistent-order"}),
+    ("lock_order_neg.py", "lock-order", 0, set()),
+    ("state_contract_pos.py", "state-contract", 5,
+     {"reduce-default", "list-state-reduce", "sketch-merge", "stackable-growing-state"}),
+    ("state_contract_neg.py", "state-contract", 0, set()),
+]
+
+
+@pytest.mark.parametrize("fname,pass_name,count,rules", FIXTURE_CASES)
+def test_fixture_finding_counts(fname, pass_name, count, rules):
+    findings = analyze_source(pass_name, _fixture(fname))
+    rendered = "\n".join(f.render() for f in findings)
+    assert len(findings) == count, rendered
+    assert {f.rule for f in findings} == rules, rendered
+
+
+def test_suppression_markers_silence_findings():
+    src = _fixture("suppressed.py")
+    assert analyze_source("trace-safety", src) == []
+    assert analyze_source(
+        "shape-static", src, rel="metrics_tpu/streaming/synthetic.py"
+    ) == []
+    # strip the markers and both violations surface — the markers did the work
+    stripped = re.sub(r"#\s*analyze:[^\n]*", "", src)
+    assert len(analyze_source("trace-safety", stripped)) == 1
+    # shape-static sees both the nonzero and the .item() once unsuppressed
+    exposed = analyze_source(
+        "shape-static", stripped, rel="metrics_tpu/streaming/synthetic.py"
+    )
+    assert {f.rule for f in exposed} == {"dynamic-shape", "host-pull"}
+
+
+# ---------------------------------------------------------------------------
+# discovery: a newly planted module is analyzed with no list to update
+# ---------------------------------------------------------------------------
+
+
+def _plant_tree(tmp_path):
+    """A miniature package with one violation per scope."""
+    pkg = tmp_path / "metrics_tpu"
+    (pkg / "streaming").mkdir(parents=True)
+    (pkg / "serve").mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "streaming" / "__init__.py").write_text("")
+    (pkg / "serve" / "__init__.py").write_text("")
+    (pkg / "streaming" / "fresh.py").write_text(
+        textwrap.dedent(
+            """\
+            import jax.numpy as jnp
+
+            def bad(x):
+                return jnp.nonzero(x)
+            """
+        )
+    )
+    (pkg / "serve" / "fresh.py").write_text(
+        textwrap.dedent(
+            """\
+            def handler(backend):
+                return backend.psum(1.0)
+            """
+        )
+    )
+    return tmp_path
+
+
+def test_discovery_finds_planted_modules(tmp_path):
+    root = _plant_tree(tmp_path)
+    units = discover_units(str(root))
+    assert {u.rel for u in units} >= {
+        "metrics_tpu/streaming/fresh.py",
+        "metrics_tpu/serve/fresh.py",
+    }
+    report = run_passes(AST_PASSES, root=str(root), baseline_path=None)
+    rules = {(f.pass_name, f.rule) for f in report.findings}
+    assert ("shape-static", "dynamic-shape") in rules
+    assert ("serve-blocking", "blocking-call") in rules
+    assert not report.ok
+
+
+# ---------------------------------------------------------------------------
+# baseline: round-trip through --update-baseline semantics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip_preserves_justifications(tmp_path):
+    root = _plant_tree(tmp_path)
+    baseline = tmp_path / "baseline.json"
+    raw = run_passes(AST_PASSES, root=str(root), baseline_path=None, collect_all=True)
+    entries = update_baseline(raw.findings, path=str(baseline))
+    assert entries and all(
+        e["justification"] == "TODO: justify" for e in entries.values()
+    )
+
+    # a reviewed justification survives regeneration
+    payload = json.loads(baseline.read_text())
+    key = sorted(payload["entries"])[0]
+    payload["entries"][key]["justification"] = "reviewed: deliberate"
+    baseline.write_text(json.dumps(payload))
+    entries = update_baseline(raw.findings, path=str(baseline))
+    assert entries[key]["justification"] == "reviewed: deliberate"
+
+    # with the baseline in force, the same tree is clean
+    report = run_passes(AST_PASSES, root=str(root), baseline_path=str(baseline))
+    assert report.ok and report.findings == []
+    assert len(report.baselined) == len(raw.findings)
+
+
+def test_committed_baseline_entries_are_justified():
+    entries = load_baseline(BASELINE_PATH)
+    for key, entry in entries.items():
+        assert entry.get("justification", "").strip(), key
+        assert "TODO" not in entry["justification"], key
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args, **kwargs):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analyze", *args],
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=300,
+        **kwargs,
+    )
+
+
+def test_cli_exits_zero_on_clean_repo():
+    args = [a for name in CLI_PASSES for a in ("--pass", name)]
+    proc = _cli(*args, "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert set(payload["per_pass"]) == set(CLI_PASSES)
+
+
+def test_cli_exits_nonzero_on_violation(tmp_path):
+    root = _plant_tree(tmp_path)
+    proc = _cli("--pass", "shape-static", "--root", str(root))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "dynamic-shape" in proc.stdout
+
+
+def test_cli_rejects_unknown_pass():
+    proc = _cli("--pass", "no-such-pass")
+    assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# legacy shims keep their public surface
+# ---------------------------------------------------------------------------
+
+sys.path.insert(0, str(REPO / "tools"))
+
+import ckpt_lint  # noqa: E402
+import obs_lint  # noqa: E402
+import serve_lint  # noqa: E402
+import shape_lint  # noqa: E402
+
+
+def test_shape_shim_clean_and_covers_dirs():
+    assert shape_lint.lint() == []
+    covered = {os.path.basename(d) for d in shape_lint.LINTED_DIRS}
+    assert {"streaming", "multistream", "serve"} <= covered
+
+
+def test_shape_shim_flags_dynamic_shapes():
+    src = "\n".join(
+        [
+            "import jax.numpy as jnp",
+            "def bad(x):",
+            "    idx = jnp.nonzero(x)",
+            "    n = x.sum().item()",
+            "    return idx, n",
+        ]
+    )
+    problems = shape_lint.lint_source(src, "synthetic.py")
+    flagged = "\n".join(problems)
+    assert "nonzero" in flagged and ".item()" in flagged
+    assert len(problems) == 2
+    assert shape_lint.lint_source(
+        "import jax.numpy as jnp\ndef good(x):\n    return jnp.where(x > 0, x, 0.0)\n",
+        "synthetic.py",
+    ) == []
+
+
+def test_serve_shim_clean_and_discovers_modules():
+    assert serve_lint.lint() == []
+    covered = {os.path.basename(m) for m in serve_lint.LINTED_MODULES}
+    assert {"httpd.py", "ingest.py", "registry.py", "traffic.py"} <= covered
+    # the durability layer opts out via skip-file markers, not a hand list
+    assert "server.py" not in covered
+    assert "soak.py" not in covered
+
+
+def test_serve_shim_flags_blocking_and_banned():
+    problems = serve_lint.lint_source(
+        "def handler(backend):\n    return backend.psum(1.0)\n", "synthetic.py"
+    )
+    assert len(problems) == 1 and "`psum(...)`" in problems[0]
+    problems = serve_lint.lint_source(
+        "from metrics_tpu.checkpoint.manager import CheckpointManager\n", "synthetic.py"
+    )
+    assert problems and "must stay out of request-path modules" in problems[0]
+
+
+def test_obs_and_ckpt_shims_clean():
+    assert obs_lint.lint() == []
+    assert ckpt_lint.lint() == []
+    assert ckpt_lint.lint_roundtrip() == []
+
+
+# ---------------------------------------------------------------------------
+# heuristic precision regressions (each encodes a triaged false positive)
+# ---------------------------------------------------------------------------
+
+
+def test_scan_operands_are_not_marked_traced():
+    # lax.scan(f, init, xs): only f is a traced function — a host helper
+    # passed in operand position must not inherit trace-safety rules.
+    src = textwrap.dedent(
+        """\
+        import jax
+
+        def pull(m):
+            return m.val.item()
+
+        def step(c, x):
+            return c + x, None
+
+        def run(m, xs):
+            return jax.lax.scan(step, pull, xs)
+        """
+    )
+    assert analyze_source("trace-safety", src) == []
+
+
+def test_static_argnames_are_not_arrayish():
+    src = textwrap.dedent(
+        """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def topk_rate(x, k):
+            if k > 1:
+                return float(k) * x.sum()
+            return x.sum()
+        """
+    )
+    assert analyze_source("trace-safety", src) == []
+
+
+def test_string_mode_compares_are_exempt():
+    src = textwrap.dedent(
+        """\
+        import jax
+
+        @jax.jit
+        def reduce(x, mode):
+            if mode == "sum":
+                return x.sum()
+            return x.mean()
+        """
+    )
+    assert analyze_source("trace-safety", src) == []
+
+
+# ---------------------------------------------------------------------------
+# the stackable contract is enforced at runtime too
+# ---------------------------------------------------------------------------
+
+
+def test_multistream_rejects_unstackable_base():
+    from metrics_tpu.aggregation import CatMetric
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+    from metrics_tpu.multistream import MultiStreamMetric
+
+    with pytest.raises(MetricsTPUUserError, match="stackable"):
+        MultiStreamMetric(CatMetric(), num_streams=2)

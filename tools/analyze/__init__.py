@@ -1,0 +1,42 @@
+"""Unified static-analysis engine for the metrics_tpu repo.
+
+Run it as ``python -m tools.analyze`` (see ``--help``); use
+:func:`run_passes` in-process (bench does).  The engine, suppression
+model, and pass API live in :mod:`tools.analyze.engine`; the bundled
+passes in :mod:`tools.analyze.passes`.
+"""
+
+from tools.analyze.engine import (  # noqa: F401
+    BASELINE_PATH,
+    PASSES,
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleUnit,
+    Report,
+    analyze_source,
+    discover_units,
+    load_baseline,
+    register_pass,
+    run_passes,
+    update_baseline,
+)
+
+__all__ = [
+    "BASELINE_PATH",
+    "PASSES",
+    "AnalysisContext",
+    "AnalysisPass",
+    "Finding",
+    "ModuleUnit",
+    "Report",
+    "analyze_source",
+    "discover_units",
+    "load_baseline",
+    "register_pass",
+    "run_passes",
+    "update_baseline",
+]
+
+# importing the subpackage registers the bundled passes into PASSES
+from tools.analyze import passes as _passes  # noqa: E402,F401

@@ -1,0 +1,104 @@
+"""CLI for the unified static-analysis engine.
+
+    python -m tools.analyze                 # run every pass, human output
+    python -m tools.analyze --json          # machine-readable report
+    python -m tools.analyze --pass lock-order --pass trace-safety
+    python -m tools.analyze --list-passes
+    python -m tools.analyze --update-baseline
+
+Exit status: 0 when every finding is baselined (or none), 1 when any fresh
+finding exists, 2 on usage errors.  ``--update-baseline`` rewrites
+``tools/analyze/baseline.json`` from the current findings (preserving
+existing justifications) and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.analyze import engine
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="Run the metrics_tpu static-analysis passes.",
+    )
+    parser.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        metavar="NAME",
+        help="run only this pass (repeatable; default: all)",
+    )
+    parser.add_argument("--json", action="store_true", help="emit a JSON report")
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list registered passes and exit"
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite baseline.json from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--show-baselined",
+        action="store_true",
+        help="also print findings absorbed by the baseline",
+    )
+    parser.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    from tools.analyze import passes as _passes  # noqa: F401  (register)
+
+    if args.list_passes:
+        for name in sorted(engine.PASSES):
+            p = engine.PASSES[name]
+            print(f"{name:22s} [{p.kind}] {p.description}")
+        return 0
+
+    try:
+        report = engine.run_passes(
+            pass_names=args.passes,
+            root=args.root,
+            collect_all=args.update_baseline,
+        )
+    except KeyError as err:
+        print(err.args[0], file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        entries = engine.update_baseline(report.findings)
+        print(
+            f"baseline rewritten: {len(entries)} fingerprint(s) covering "
+            f"{len(report.findings)} finding(s)"
+        )
+        todo = [k for k, e in entries.items() if e["justification"].startswith("TODO")]
+        for key in todo:
+            print(f"  needs justification: {key}")
+        return 0
+
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+        return 0 if report.ok else 1
+
+    for f in report.findings:
+        print(f.render())
+    if args.show_baselined:
+        for f in report.baselined:
+            print(f"(baselined) {f.render()}")
+    print(
+        f"{len(report.findings)} finding(s), {len(report.baselined)} baselined, "
+        f"{report.modules_analyzed} modules, "
+        f"{len(report.per_pass)} pass(es): "
+        + ", ".join(
+            f"{name}={stats['findings']}+{stats['baselined']}b"
+            for name, stats in sorted(report.per_pass.items())
+        )
+    )
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

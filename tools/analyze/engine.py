@@ -1,0 +1,554 @@
+"""Core of the unified static-analysis engine (``tools/analyze``).
+
+One AST/scope engine shared by every pass: package-walk module discovery,
+per-module import-alias resolution (so ``import jax.numpy as np`` and
+``import numpy as jnp`` are told apart), inline suppression markers, a pass
+registry with per-pass severity, and a checked-in suppression baseline
+(``tools/analyze/baseline.json``) keyed on stable finding fingerprints
+rather than line numbers.
+
+Suppression has three layers, from broadest to narrowest:
+
+* **file opt-out** — a ``# analyze: skip-file[pass-a,pass-b] -- reason``
+  comment anywhere in a module removes it from those passes' scope (``*``
+  opts out of everything).  This replaces the old hand-maintained
+  ``LINTED_MODULES`` / ``LINTED_DIRS`` lists: every module found by the
+  package walk is analyzed by default, and the deliberate exceptions carry
+  their justification in the file itself.
+* **line ignore** — a trailing ``# analyze: ignore[pass-a] -- reason``
+  suppresses findings any listed pass reports on that line.
+* **baseline** — ``baseline.json`` maps finding fingerprints (pass, module,
+  rule, detail) to an occurrence count and a one-line justification; it is
+  how deliberate repo-wide patterns are accepted without editing the code.
+  ``python -m tools.analyze --update-baseline`` rewrites it from the
+  current findings, preserving existing justifications.
+
+Passes subclass :class:`AnalysisPass` and register with
+:func:`register_pass`; AST passes implement ``check_module`` (plus
+``finish`` for cross-module aggregation, e.g. the lock-acquisition graph),
+dynamic passes implement ``check_package``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE = "metrics_tpu"
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+_MARKER_RE = re.compile(
+    r"#\s*analyze:\s*(?P<kind>skip-file|ignore)\[(?P<names>[^\]]+)\]"
+    r"(?:\s*--\s*(?P<reason>.*))?"
+)
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analysis finding with a line-drift-stable fingerprint.
+
+    ``rule`` is the short machine id of the check that fired; ``detail`` is
+    the stable context string (usually ``<function qualname>:<offender>``)
+    that, together with pass and module, keys the baseline — line numbers
+    are display-only so baselines survive unrelated edits.
+    """
+
+    pass_name: str
+    module: str
+    lineno: int
+    rule: str
+    detail: str
+    message: str
+    severity: str = "error"
+
+    @property
+    def key(self) -> str:
+        return "::".join((self.pass_name, self.module, self.rule, self.detail))
+
+    def render(self) -> str:
+        loc = f"{self.module}:{self.lineno}" if self.lineno else self.module
+        return f"{self.pass_name}: {loc}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# module units
+
+
+class ModuleUnit:
+    """One source file: lazily-parsed AST, markers, and import aliases."""
+
+    def __init__(self, rel: str, source: str, path: Optional[str] = None) -> None:
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.path = path
+        self.parse_error: Optional[SyntaxError] = None
+        self._tree: Optional[ast.Module] = None
+        self._parsed = False
+        self._imports: Optional[Dict[str, str]] = None
+        # markers
+        self.skip_passes: Set[str] = set()
+        self.skip_reasons: Dict[str, str] = {}
+        self.ignores: Dict[int, Set[str]] = {}
+        self._scan_markers()
+
+    # ------------------------------------------------------------- markers
+    def _scan_markers(self) -> None:
+        for lineno, line in enumerate(self.source.splitlines(), start=1):
+            m = _MARKER_RE.search(line)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group("names").split(",") if n.strip()}
+            if m.group("kind") == "skip-file":
+                self.skip_passes |= names
+                reason = (m.group("reason") or "").strip()
+                for n in names:
+                    self.skip_reasons.setdefault(n, reason)
+            else:
+                self.ignores.setdefault(lineno, set()).update(names)
+
+    def skips(self, pass_name: str) -> bool:
+        return pass_name in self.skip_passes or "*" in self.skip_passes
+
+    def ignored(self, pass_name: str, lineno: int) -> bool:
+        names = self.ignores.get(lineno)
+        return bool(names) and (pass_name in names or "*" in names)
+
+    # --------------------------------------------------------------- parse
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.source, filename=self.rel)
+            except SyntaxError as err:
+                self.parse_error = err
+        return self._tree
+
+    @property
+    def dotted(self) -> str:
+        """This module's dotted import path (``metrics_tpu.serve.ingest``)."""
+        mod = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        parts = mod.split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    # -------------------------------------------------------------- imports
+    @property
+    def imports(self) -> Dict[str, str]:
+        """Local name -> dotted module/attr it aliases.
+
+        ``import jax.numpy as np`` maps ``np -> jax.numpy`` while
+        ``import numpy as np`` maps ``np -> numpy`` — this is what lets the
+        trace-safety pass tell a host ``asarray`` from a device one.
+        """
+        if self._imports is None:
+            self._imports = {}
+            tree = self.tree
+            if tree is not None:
+                pkg_parts = self.dotted.split(".")
+                if self.rel.endswith("/__init__.py"):
+                    pkg_parts = pkg_parts + [""]  # relative level 1 = this pkg
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Import):
+                        for alias in node.names:
+                            if alias.asname:
+                                self._imports[alias.asname] = alias.name
+                            else:
+                                head = alias.name.split(".")[0]
+                                self._imports[head] = head
+                    elif isinstance(node, ast.ImportFrom):
+                        if node.level:
+                            base_parts = pkg_parts[: len(pkg_parts) - node.level]
+                            mod = ".".join(base_parts + ([node.module] if node.module else []))
+                        else:
+                            mod = node.module or ""
+                        for alias in node.names:
+                            local = alias.asname or alias.name
+                            self._imports[local] = f"{mod}.{alias.name}" if mod else alias.name
+        return self._imports
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain through the import aliases.
+
+        ``np.asarray`` with ``import numpy as np`` -> ``numpy.asarray``.
+        Returns ``None`` for expressions that are not plain dotted names.
+        """
+        dotted = dotted_name(expr)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        base = self.imports.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+def dotted_name(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else ``None``."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def expr_text(expr: ast.AST) -> str:
+    """Compact source-ish text for an expression (lock identities etc.)."""
+    try:
+        return ast.unparse(expr)
+    except Exception:
+        return dotted_name(expr) or "<expr>"
+
+
+def walk_with_scope(tree: ast.Module) -> Iterator[Tuple[ast.AST, str]]:
+    """Yield ``(node, enclosing_qualname)`` pairs; module level is ``""``."""
+
+    def visit(node: ast.AST, scope: str) -> Iterator[Tuple[ast.AST, str]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = f"{scope}.{child.name}" if scope else child.name
+                yield child, scope
+                yield from visit(child, inner)
+            elif isinstance(child, ast.ClassDef):
+                inner = f"{scope}.{child.name}" if scope else child.name
+                yield child, scope
+                yield from visit(child, inner)
+            elif isinstance(child, ast.Lambda):
+                inner = f"{scope}.<lambda@{child.lineno}>" if scope else f"<lambda@{child.lineno}>"
+                yield child, scope
+                yield from visit(child, inner)
+            else:
+                yield child, scope
+                yield from visit(child, scope)
+
+    yield from visit(tree, "")
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+
+
+class AnalysisPass:
+    """Base class for one analysis pass.
+
+    ``kind`` is ``"ast"`` (per-module ``check_module`` + optional cross-
+    module ``finish``) or ``"dynamic"`` (``check_package`` imports the live
+    package).  ``severity`` is the default stamped on findings.
+    """
+
+    name: str = ""
+    description: str = ""
+    severity: str = "error"
+    kind: str = "ast"
+
+    def applies(self, unit: ModuleUnit) -> bool:
+        return True
+
+    def check_module(self, unit: ModuleUnit, ctx: "AnalysisContext") -> List[Finding]:
+        return []
+
+    def finish(self, ctx: "AnalysisContext") -> List[Finding]:
+        return []
+
+    def check_package(self, ctx: "AnalysisContext") -> List[Finding]:
+        return []
+
+    # helper so passes build findings with their own name/severity
+    def finding(
+        self,
+        module: str,
+        lineno: int,
+        rule: str,
+        detail: str,
+        message: str,
+        severity: Optional[str] = None,
+    ) -> Finding:
+        return Finding(
+            pass_name=self.name,
+            module=module,
+            lineno=lineno,
+            rule=rule,
+            detail=detail,
+            message=message,
+            severity=severity or self.severity,
+        )
+
+
+PASSES: Dict[str, AnalysisPass] = {}
+
+
+def register_pass(cls: Callable[[], AnalysisPass]):
+    """Class decorator: instantiate and add to the global registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"{cls!r} has no pass name")
+    if inst.name in PASSES:
+        raise ValueError(f"duplicate pass name {inst.name!r}")
+    PASSES[inst.name] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# discovery
+
+
+def discover_units(root: Optional[str] = None, package: str = PACKAGE) -> List[ModuleUnit]:
+    """Package walk: every ``*.py`` under ``<root>/<package>``, sorted.
+
+    This is the single source of scope truth — a new module is analyzed by
+    default; opting out takes an explicit ``skip-file`` marker with a
+    reason, not absence from a hand-maintained list.
+    """
+    root = os.path.abspath(root or REPO_ROOT)
+    pkg_dir = os.path.join(root, package)
+    units: List[ModuleUnit] = []
+    for base, dirs, files in sorted(os.walk(pkg_dir)):
+        dirs.sort()
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(base, fname)
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            rel = os.path.relpath(path, root)
+            units.append(ModuleUnit(rel, source, path=path))
+    return units
+
+
+class AnalysisContext:
+    """Everything a pass can see: the module table plus per-run scratch."""
+
+    def __init__(self, units: List[ModuleUnit], root: str) -> None:
+        self.units = units
+        self.root = root
+        self.scratch: Dict[str, Any] = {}
+
+    def unit(self, rel: str) -> Optional[ModuleUnit]:
+        rel = rel.replace(os.sep, "/")
+        for u in self.units:
+            if u.rel == rel:
+                return u
+        return None
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: str = BASELINE_PATH) -> Dict[str, Dict[str, Any]]:
+    """``{finding key: {"count": int, "justification": str}}`` (empty if absent)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return dict(data.get("entries", {}))
+
+
+def split_baselined(
+    findings: Sequence[Finding], baseline: Dict[str, Dict[str, Any]]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Partition into (fresh, baselined); each baseline entry absorbs up to
+    ``count`` occurrences of its fingerprint."""
+    budget = {key: int(entry.get("count", 0)) for key, entry in baseline.items()}
+    fresh: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            suppressed.append(f)
+        else:
+            fresh.append(f)
+    return fresh, suppressed
+
+
+def update_baseline(
+    findings: Sequence[Finding],
+    path: str = BASELINE_PATH,
+    default_justification: str = "TODO: justify",
+) -> Dict[str, Dict[str, Any]]:
+    """Rewrite the baseline from the current findings.
+
+    Existing justifications are preserved key-by-key; new fingerprints get
+    ``default_justification`` so a review can't miss them.  Entries whose
+    finding disappeared are dropped — the baseline only ever shrinks or
+    gains reviewed entries.
+    """
+    previous = load_baseline(path)
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    entries = {
+        key: {
+            "count": n,
+            "justification": previous.get(key, {}).get(
+                "justification", default_justification
+            ),
+        }
+        for key, n in sorted(counts.items())
+    }
+    payload = {
+        "comment": (
+            "Suppression baseline for python -m tools.analyze. Keys are "
+            "pass::module::rule::detail fingerprints (line-number free). "
+            "Regenerate with --update-baseline; every entry needs a one-line "
+            "justification."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+@dataclasses.dataclass
+class Report:
+    """Outcome of one engine run: fresh findings gate, the rest is telemetry."""
+
+    findings: List[Finding]
+    baselined: List[Finding]
+    per_pass: Dict[str, Dict[str, int]]
+    modules_analyzed: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "findings_total": len(self.findings),
+            "baselined_total": len(self.baselined),
+            "modules_analyzed": self.modules_analyzed,
+            "per_pass": self.per_pass,
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+
+def run_passes(
+    pass_names: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    units: Optional[List[ModuleUnit]] = None,
+    baseline_path: Optional[str] = BASELINE_PATH,
+    collect_all: bool = False,
+) -> Report:
+    """Run the selected passes (default: all) over the package walk.
+
+    ``collect_all=True`` skips baseline filtering (used by
+    ``--update-baseline``, which needs the raw findings).
+    """
+    # ensure the bundled passes are registered even when the caller imported
+    # engine directly
+    from tools.analyze import passes as _passes  # noqa: F401
+
+    root = os.path.abspath(root or REPO_ROOT)
+    if units is None:
+        units = discover_units(root)
+    ctx = AnalysisContext(units, root)
+    selected = list(pass_names) if pass_names else sorted(PASSES)
+    unknown = [n for n in selected if n not in PASSES]
+    if unknown:
+        raise KeyError(f"unknown pass(es) {unknown}; registered: {sorted(PASSES)}")
+
+    raw: List[Finding] = []
+    for name in selected:
+        p = PASSES[name]
+        if p.kind == "dynamic":
+            raw.extend(p.check_package(ctx))
+            continue
+        for unit in units:
+            if unit.skips(p.name) or not p.applies(unit):
+                continue
+            if unit.tree is None:
+                raw.append(
+                    p.finding(
+                        unit.rel,
+                        unit.parse_error.lineno or 0 if unit.parse_error else 0,
+                        "syntax-error",
+                        "parse",
+                        f"does not parse: {unit.parse_error and unit.parse_error.msg}",
+                    )
+                )
+                continue
+            for f in p.check_module(unit, ctx):
+                if not unit.ignored(p.name, f.lineno):
+                    raw.append(f)
+        for f in p.finish(ctx):
+            unit = ctx.unit(f.module)
+            if unit is not None and (unit.skips(p.name) or unit.ignored(p.name, f.lineno)):
+                continue
+            raw.append(f)
+
+    baseline = {} if (collect_all or not baseline_path) else load_baseline(baseline_path)
+    fresh, suppressed = split_baselined(raw, baseline)
+    per_pass: Dict[str, Dict[str, int]] = {
+        name: {"findings": 0, "baselined": 0} for name in selected
+    }
+    for f in fresh:
+        per_pass[f.pass_name]["findings"] += 1
+    for f in suppressed:
+        per_pass[f.pass_name]["baselined"] += 1
+    return Report(
+        findings=fresh,
+        baselined=suppressed,
+        per_pass=per_pass,
+        modules_analyzed=len(units),
+    )
+
+
+def analyze_source(
+    pass_name: str,
+    source: str,
+    rel: str = "metrics_tpu/synthetic.py",
+) -> List[Finding]:
+    """Run ONE AST pass over one source string under a pretend path.
+
+    The fixture/test entry point (and what the legacy ``lint_source`` shims
+    call): markers in the source are honored, the baseline is not.
+    """
+    from tools.analyze import passes as _passes  # noqa: F401
+
+    p = PASSES[pass_name]
+    if p.kind != "ast":
+        raise ValueError(f"pass {pass_name!r} is dynamic; analyze_source needs an AST pass")
+    unit = ModuleUnit(rel, source)
+    ctx = AnalysisContext([unit], REPO_ROOT)
+    ctx.scratch["fixture_mode"] = True  # passes skip live-package halves
+    if unit.skips(p.name) or not p.applies(unit):
+        return []
+    if unit.tree is None:
+        err = unit.parse_error
+        return [
+            p.finding(
+                unit.rel,
+                (err.lineno or 0) if err else 0,
+                "syntax-error",
+                "parse",
+                f"does not parse: {err and err.msg}",
+            )
+        ]
+    out = [f for f in p.check_module(unit, ctx) if not unit.ignored(p.name, f.lineno)]
+    for f in p.finish(ctx):
+        if not unit.ignored(p.name, f.lineno):
+            out.append(f)
+    return out

@@ -1,0 +1,17 @@
+"""Bundled analysis passes.
+
+Importing this package registers every pass with the engine's global
+registry (``tools.analyze.engine.PASSES``).  A new pass is one module here:
+subclass :class:`tools.analyze.engine.AnalysisPass`, decorate with
+``@register_pass``, and import it below.
+"""
+
+from tools.analyze.passes import (  # noqa: F401
+    ckpt_serializers,
+    lock_order,
+    obs_instrumentation,
+    serve_blocking,
+    shape_static,
+    state_contract,
+    trace_safety,
+)

@@ -1,0 +1,172 @@
+"""ckpt-serializers: every registered state kind has a checkpoint serializer.
+
+The checkpoint codec (``metrics_tpu/checkpoint/codec.py``) serializes metric
+state BY KIND — the ``SERIALIZERS`` registry maps each kind reported by
+``Metric.state_kinds()`` to its pack/unpack/merge path.  A new state
+registration API (``add_*_state``) or a new kind that lands without a codec
+entry would silently produce checkpoints that drop that state, or restores
+that KeyError in production.  This dynamic pass pins the three surfaces
+together:
+
+1. every ``add*_state`` method on ``Metric`` appears in
+   ``STATE_KIND_REGISTRARS`` (new registration APIs must declare their kinds);
+2. every kind named by ``STATE_KIND_REGISTRARS`` has a ``SERIALIZERS`` entry;
+3. every kind ``state_kinds()`` can emit — probed by instantiating one
+   exemplar metric per kind — round-trips through ``encode_metric`` /
+   ``decode_metric`` with digests verifying.
+
+This pass is the ported ``tools/ckpt_lint.py`` (its module entry point
+remains as a shim).  The state-contract pass reuses the coverage helpers
+here for its serializer-coverage rule.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    register_pass,
+)
+
+_REGISTRAR_RE = re.compile(r"^add[a-z_]*_state$")
+_CODEC_REL = "metrics_tpu/checkpoint/codec.py"
+
+
+def coverage_problems() -> List[Tuple[str, str, str]]:
+    """``(rule, detail, message)`` rows for registrar/serializer coverage."""
+    from metrics_tpu.checkpoint.codec import META_STATE, SERIALIZERS, STATE_KIND_REGISTRARS
+    from metrics_tpu.metric import Metric
+
+    problems: List[Tuple[str, str, str]] = []
+
+    # 1. every state-registration API on Metric is declared
+    registrars = sorted(
+        name
+        for name in vars(Metric)
+        if _REGISTRAR_RE.match(name) and callable(getattr(Metric, name))
+    )
+    for name in registrars:
+        if name not in STATE_KIND_REGISTRARS:
+            problems.append(
+                (
+                    "registrar-undeclared",
+                    f"Metric.{name}",
+                    f"Metric.{name}() registers state but is missing from "
+                    "checkpoint.codec.STATE_KIND_REGISTRARS — declare which "
+                    "codec kind(s) it produces so checkpoints cover it.",
+                )
+            )
+    for name in STATE_KIND_REGISTRARS:
+        if name not in registrars:
+            problems.append(
+                (
+                    "registrar-stale",
+                    f"Metric.{name}",
+                    f"checkpoint.codec.STATE_KIND_REGISTRARS names {name!r} but "
+                    "Metric has no such registration method — stale entry.",
+                )
+            )
+
+    # 2. every declared kind has a serializer
+    declared = {k for kinds in STATE_KIND_REGISTRARS.values() for k in kinds}
+    for kind in sorted(declared):
+        if kind not in SERIALIZERS:
+            problems.append(
+                (
+                    "serializer-missing",
+                    f"kind.{kind}",
+                    f"state kind {kind!r} (declared in STATE_KIND_REGISTRARS) "
+                    "has no checkpoint.codec.SERIALIZERS entry — it would be "
+                    "dropped from every checkpoint.",
+                )
+            )
+    for kind in SERIALIZERS:
+        if kind != META_STATE and kind not in declared:
+            problems.append(
+                (
+                    "serializer-stale",
+                    f"kind.{kind}",
+                    f"checkpoint.codec.SERIALIZERS entry {kind!r} is produced "
+                    "by no registration API in STATE_KIND_REGISTRARS — stale "
+                    "entry.",
+                )
+            )
+    return problems
+
+
+def roundtrip_problems() -> List[Tuple[str, str, str]]:
+    """Probe one exemplar metric per kind through an encode/decode cycle."""
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu.checkpoint.codec import decode_metric, encode_metric
+
+    exemplars = {
+        "tensor": (mt.MeanMetric(), lambda m: m.update(jnp.arange(4.0))),
+        "list": (mt.CatMetric(), lambda m: m.update(jnp.arange(4.0))),
+        "buffer": (
+            mt.AUROC(),
+            lambda m: m.update(
+                jnp.asarray([0.1, 0.8, 0.4, 0.9]), jnp.asarray([0, 1, 0, 1])
+            ),
+        ),
+        "sketch": (mt.StreamingQuantile(), lambda m: m.update(jnp.arange(32.0))),
+    }
+    problems: List[Tuple[str, str, str]] = []
+    for kind, (metric, feed) in exemplars.items():
+        feed(metric)
+        kinds = set(metric.state_kinds().values())
+        if kind not in kinds:
+            problems.append(
+                (
+                    "roundtrip-exemplar",
+                    f"kind.{kind}",
+                    f"exemplar for kind {kind!r} ({type(metric).__name__}) "
+                    f"reports kinds {sorted(kinds)} — update the exemplar "
+                    "table.",
+                )
+            )
+            continue
+        enc = encode_metric(metric)
+        dec = decode_metric(enc.blob, enc.digests)
+        if dec.failed:
+            problems.append(
+                (
+                    "roundtrip-failed",
+                    f"kind.{kind}",
+                    f"kind {kind!r} ({type(metric).__name__}) failed its own "
+                    f"encode/decode round trip: state(s) {dec.failed} did not "
+                    "verify.",
+                )
+            )
+        missing = set(enc.digests) - set(dec.arrays) - set(dec.failed)
+        if missing:
+            problems.append(
+                (
+                    "roundtrip-lost",
+                    f"kind.{kind}",
+                    f"kind {kind!r} round trip silently lost state(s) "
+                    f"{sorted(missing)}.",
+                )
+            )
+    return problems
+
+
+@register_pass
+class CkptSerializersPass(AnalysisPass):
+    name = "ckpt-serializers"
+    description = (
+        "every Metric state kind is declared to the checkpoint codec, has a "
+        "serializer, and round-trips encode/decode with digests verifying"
+    )
+    kind = "dynamic"
+
+    def check_package(self, ctx: AnalysisContext) -> List[Finding]:
+        return [
+            self.finding(_CODEC_REL, 0, rule, detail, message)
+            for rule, detail, message in coverage_problems() + roundtrip_problems()
+        ]

@@ -1,0 +1,285 @@
+"""lock-order: consistent lock acquisition order, nothing blocks under a lock.
+
+The threaded layers (serve's per-job ``RLock``s + ``registry.locked()``
+sweep + the server's ``_ckpt_lock``, the checkpoint manager, obs' runtime
+lock, the native-build lock) stay deadlock-free by two structural rules
+this pass checks statically — the static sibling of the PR-7 TOCTOU-hang
+fix:
+
+* **lock-order** — the static lock-acquisition graph (an edge A -> B when B
+  is acquired while A is held, aggregated across the whole package) must
+  not contain a 2-cycle: if one code path takes A then B and another takes
+  B then A, two threads can deadlock.  Lock identity is the normalized
+  acquisition expression (``self.`` stripped), so ``job.lock`` in one
+  module and ``self.lock`` in another unify per attribute path.
+* **blocking-under-lock** — while any lock is held, no call may park the
+  thread on something unbounded: collectives / barriers / KV waits /
+  checkpoint commits (the serve-blocking vocabulary), untimed
+  ``queue.put``/``queue.get`` (a dead consumer never drains the queue —
+  exactly the PR-7 flush hang), zero-argument ``.wait()`` / ``.join()``,
+  ``time.sleep``, and socket/HTTP reads.  One same-module call hop is
+  followed: a call under a lock to a local function that itself blocks is
+  flagged at the call site.
+
+Deliberate quiesce points (the durability loop's save/restore under
+``registry.locked()``, the soak harness' operator sync under a job lock)
+are baselined with justifications rather than silenced in code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleUnit,
+    expr_text,
+    register_pass,
+)
+from tools.analyze.passes.serve_blocking import BLOCKING_CALLS as COLLECTIVE_CALLS
+
+# attribute reads that look like sockets/HTTP: parked on a peer
+SOCKET_CALLS = {"urlopen", "recv", "accept", "connect", "sendall", "getresponse"}
+
+_SCRATCH = "lock-order"
+
+
+def _lock_id(expr: ast.AST) -> Optional[str]:
+    """Normalized lock identity for a with-item / acquire receiver, or None."""
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "locked":
+            base = expr_text(fn.value)
+            return f"{_strip_self(base)}.locked()"
+        return None
+    text = None
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        text = expr_text(expr)
+    elif isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        text = expr.id
+    return _strip_self(text) if text else None
+
+
+def _strip_self(text: str) -> str:
+    for prefix in ("self.", "cls."):
+        if text.startswith(prefix):
+            return text[len(prefix):]
+    return text
+
+
+def _receiver_is_queueish(expr: ast.AST) -> bool:
+    last = expr_text(expr).split(".")[-1].lower()
+    return last in ("q", "queue") or last.endswith("_q") or "queue" in last
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _blocking_reason(call: ast.Call, unit: ModuleUnit) -> Optional[str]:
+    """Why this call can park the thread unboundedly, or None."""
+    fn = call.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if attr in COLLECTIVE_CALLS:
+        return f"`{attr}(...)` blocks on peers (collective/barrier/KV/commit)"
+    if attr in SOCKET_CALLS:
+        return f"`{attr}(...)` parks on a socket"
+    if (
+        attr in ("put", "get")
+        and isinstance(fn, ast.Attribute)
+        and _receiver_is_queueish(fn.value)
+        and not _has_kwarg(call, "timeout")
+        and not (attr == "get" and call.args)  # q.get(timeout) positional
+    ):
+        return (
+            f"untimed `queue.{attr}` — a dead peer thread never drains/fills "
+            "the queue"
+        )
+    if attr == "wait" and not call.args and not call.keywords:
+        return "zero-argument `.wait()` parks forever if the event never fires"
+    if attr == "join" and not call.args and not call.keywords and isinstance(fn, ast.Attribute):
+        return "untimed `.join()` parks forever on a wedged thread"
+    resolved = unit.resolve(fn)
+    if resolved == "time.sleep":
+        return "`time.sleep` stalls every waiter on the held lock"
+    return None
+
+
+class _FnScan:
+    """Per-function results: findings plus call-graph hooks."""
+
+    def __init__(self) -> None:
+        self.direct_blocking: Optional[str] = None  # first blocking primitive
+        self.calls_under_lock: List[Tuple[str, int, Tuple[str, ...]]] = []
+
+
+@register_pass
+class LockOrderPass(AnalysisPass):
+    name = "lock-order"
+    description = (
+        "no inconsistent lock-acquisition order anywhere in the package, "
+        "and nothing blocking (collective, untimed queue op, bare wait/join, "
+        "sleep, socket) is called while a lock is held"
+    )
+
+    def applies(self, unit: ModuleUnit) -> bool:
+        return "lock" in unit.source.lower()
+
+    # ----------------------------------------------------------- per module
+    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        scratch = ctx.scratch.setdefault(
+            _SCRATCH, {"edges": {}}
+        )
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = scratch["edges"]
+        problems: List[Finding] = []
+
+        fns: List[Tuple[str, Optional[str], ast.AST]] = []
+
+        def collect(node: ast.AST, scope: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{scope}.{child.name}" if scope else child.name
+                    fns.append((qual, cls, child))
+                    collect(child, qual, None)
+                elif isinstance(child, ast.ClassDef):
+                    qual = f"{scope}.{child.name}" if scope else child.name
+                    collect(child, qual, qual)
+                else:
+                    collect(child, scope, cls)
+
+        collect(unit.tree, "", None)
+        by_simple: Dict[str, List[Tuple[str, Optional[str]]]] = {}
+        for qual, cls, _node in fns:
+            by_simple.setdefault(qual.rsplit(".", 1)[-1], []).append((qual, cls))
+
+        scans: Dict[str, _FnScan] = {}
+        for qual, cls, node in fns:
+            scans[qual] = self._scan_function(unit, qual, cls, node, edges, problems)
+
+        # one-hop propagation: a call under a lock to a local function that
+        # itself blocks is a blocking call at the call site
+        for qual, scan in scans.items():
+            for callee_name, lineno, held in scan.calls_under_lock:
+                caller_cls = next(c for q, c, _n in fns if q == qual)
+                for callee_qual, callee_cls in by_simple.get(callee_name, []):
+                    if callee_cls is not None and callee_cls != caller_cls:
+                        continue
+                    reason = scans[callee_qual].direct_blocking
+                    if reason:
+                        problems.append(
+                            self.finding(
+                                unit.rel,
+                                lineno,
+                                "blocking-callee-under-lock",
+                                f"{qual}:{callee_name}",
+                                f"`{callee_name}()` (which blocks: {reason}) is "
+                                f"called while holding {list(held)}",
+                            )
+                        )
+                        break
+        return problems
+
+    # --------------------------------------------------------- one function
+    def _scan_function(
+        self,
+        unit: ModuleUnit,
+        qual: str,
+        cls: Optional[str],
+        fn: ast.AST,
+        edges: Dict[Tuple[str, str], Tuple[str, int]],
+        problems: List[Finding],
+    ) -> _FnScan:
+        scan = _FnScan()
+
+        def record_acquisition(lock: str, held: Tuple[str, ...], lineno: int) -> None:
+            for h in held:
+                if h != lock:
+                    edges.setdefault((h, lock), (unit.rel, lineno))
+
+        def check_call(call: ast.Call, held: Tuple[str, ...]) -> None:
+            # standalone .acquire() is an acquisition event (release untracked)
+            if isinstance(call.func, ast.Attribute) and call.func.attr == "acquire":
+                lock = _lock_id(call.func.value)
+                if lock:
+                    record_acquisition(lock, held, call.lineno)
+                    return
+            reason = _blocking_reason(call, unit)
+            if reason and scan.direct_blocking is None:
+                scan.direct_blocking = reason
+            if held:
+                if reason:
+                    attr = (
+                        call.func.attr
+                        if isinstance(call.func, ast.Attribute)
+                        else expr_text(call.func)
+                    )
+                    problems.append(
+                        self.finding(
+                            unit.rel,
+                            call.lineno,
+                            "blocking-under-lock",
+                            f"{qual}:{attr}",
+                            f"{reason}; called while holding {list(held)} — "
+                            "release the lock first or bound the wait",
+                        )
+                    )
+                elif isinstance(call.func, ast.Name):
+                    scan.calls_under_lock.append((call.func.id, call.lineno, held))
+                elif isinstance(call.func, ast.Attribute) and isinstance(
+                    call.func.value, ast.Name
+                ) and call.func.value.id in ("self", "cls"):
+                    scan.calls_under_lock.append((call.func.attr, call.lineno, held))
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                return  # nested defs are scanned as their own functions
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    lock = _lock_id(item.context_expr)
+                    if lock:
+                        record_acquisition(lock, new_held, item.context_expr.lineno)
+                        new_held = new_held + (lock,)
+                    else:
+                        visit(item.context_expr, held)
+                for stmt in node.body:
+                    visit(stmt, new_held)
+                return
+            if isinstance(node, ast.Call):
+                check_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            visit(stmt, ())
+        return scan
+
+    # ------------------------------------------------------------ aggregate
+    def finish(self, ctx: AnalysisContext) -> List[Finding]:
+        scratch = ctx.scratch.get(_SCRATCH)
+        if not scratch:
+            return []
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = scratch["edges"]
+        problems: List[Finding] = []
+        for (a, b), (module, lineno) in sorted(edges.items()):
+            if a < b and (b, a) in edges:
+                other_mod, other_line = edges[(b, a)]
+                problems.append(
+                    self.finding(
+                        module,
+                        lineno,
+                        "inconsistent-order",
+                        f"{a}->{b}",
+                        f"lock `{b}` is acquired while holding `{a}` here, but "
+                        f"{other_mod}:{other_line} acquires `{a}` while holding "
+                        f"`{b}` — two threads on these paths can deadlock; pick "
+                        "one global order",
+                    )
+                )
+        return problems

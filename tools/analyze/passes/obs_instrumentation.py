@@ -1,0 +1,98 @@
+"""obs-instrumentation: every Metric subclass stays on the instrumented path.
+
+The obs spans/counters in ``metrics_tpu.obs`` are attached once, in
+``Metric._update_wrapper`` / ``Metric._compute_wrapper`` / ``Metric.sync`` /
+``Metric._finish_sync_report``.  A subclass that shadows one of those in its
+class dict silently drops out of the telemetry (no update/compute spans, no
+sync report recording) — which is exactly the kind of regression that never
+shows up in functional tests.  This dynamic pass imports ``metrics_tpu``,
+walks the full ``Metric`` subclass tree, and reports any first-party
+subclass that overrides an instrumented method without being allowlisted.
+
+This pass is the ported ``tools/obs_lint.py`` (its module entry point
+remains as a shim).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple, Type
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    register_pass,
+)
+
+# Methods that carry the instrumentation; overriding any of them in a class
+# dict bypasses spans, recompile counters, or sync-report recording.
+INSTRUMENTED_METHODS: Tuple[str, ...] = (
+    "_update_wrapper",
+    "_compute_wrapper",
+    "_install_wrappers",
+    "sync",
+    "_finish_sync_report",
+)
+
+# (qualified class name) -> methods it may override.  CompositionalMetric
+# re-dispatches through its operand metrics, each of which is spanned
+# individually, so its wrapper overrides do not lose telemetry.
+# MultiStreamMetric extends _finish_sync_report via super() to attribute
+# stacked-state sync traffic to the multistream.sync_bytes counter — the
+# base recording still runs first.
+ALLOWLIST: Dict[str, Set[str]] = {
+    "metrics_tpu.metric.CompositionalMetric": {"_update_wrapper", "_compute_wrapper"},
+    "metrics_tpu.multistream.core.MultiStreamMetric": {"_finish_sync_report"},
+}
+
+
+def _walk_subclasses(cls: Type) -> List[Type]:
+    out: List[Type] = []
+    for sub in cls.__subclasses__():
+        out.append(sub)
+        out.extend(_walk_subclasses(sub))
+    return out
+
+
+def _module_rel(modname: str) -> str:
+    return modname.replace(".", "/") + ".py"
+
+
+@register_pass
+class ObsInstrumentationPass(AnalysisPass):
+    name = "obs-instrumentation"
+    description = (
+        "no Metric subclass shadows the instrumented base-class update/"
+        "compute/sync wrappers"
+    )
+    kind = "dynamic"
+
+    def check_package(self, ctx: AnalysisContext) -> List[Finding]:
+        import metrics_tpu  # noqa: F401  (populates the subclass tree)
+        from metrics_tpu.metric import Metric
+
+        problems: List[Finding] = []
+        seen: Set[Type] = set()
+        for sub in _walk_subclasses(Metric):
+            if sub in seen:
+                continue
+            seen.add(sub)
+            if not sub.__module__.startswith("metrics_tpu"):
+                continue  # user-defined subclasses are out of scope
+            qualname = f"{sub.__module__}.{sub.__name__}"
+            allowed = ALLOWLIST.get(qualname, set())
+            for method in INSTRUMENTED_METHODS:
+                if method in sub.__dict__ and method not in allowed:
+                    problems.append(
+                        self.finding(
+                            _module_rel(sub.__module__),
+                            0,
+                            "shadowed-instrumentation",
+                            f"{qualname}.{method}",
+                            f"{qualname} overrides {method}(); it will bypass "
+                            "obs instrumentation. Override update()/compute() "
+                            "instead, or add an explicit allowlist entry in "
+                            "tools/analyze/passes/obs_instrumentation.py.",
+                        )
+                    )
+        return problems

@@ -1,0 +1,131 @@
+"""serve-blocking: serve request paths never block on a collective or KV wait.
+
+The serve layer's responsiveness claim is structural: an HTTP handler or
+the queue-consumer loop must never sit in a mesh collective, a distributed
+barrier, or a parked key-value wait, because a peer that died (or a
+scheduler that paused it) would turn one slow tenant read into a hung
+service.  ``MetricRegistry.register`` enforces the dynamic half (it forces
+``sync_on_compute`` / ``dist_sync_on_step`` off); this pass enforces the
+static half: the request-path modules simply do not *spell* any blocking
+primitive.
+
+Scope is every module under ``metrics_tpu/serve/`` found by the package
+walk — a NEW serve module is a request-path module until it explicitly
+opts out.  ``server.py`` (the durability loop checkpoints, which barriers
+across ranks by design) and ``soak.py`` (the harness fires explicit
+operator syncs) carry ``# analyze: skip-file[serve-blocking]`` markers.
+
+This pass is the ported ``tools/serve_lint.py`` (its module entry point
+remains as a shim).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleUnit,
+    register_pass,
+    walk_with_scope,
+)
+
+SCOPE_PREFIX = "metrics_tpu/serve/"
+
+# call names that block on peers: collectives, barriers, KV-store waits,
+# checkpoint commits (which barrier internally), and explicit metric syncs
+BLOCKING_CALLS = {
+    "sync",
+    "unsync",
+    "sync_context",
+    "wait_at_barrier",
+    "blocking_key_value_get",
+    "blocking_key_value_get_bytes",
+    "all_gather",
+    "all_gather_bytes",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "preflight_check",
+    "save",
+    "save_now",
+    "maybe_save",
+    "restore",
+    "barrier",
+}
+
+# importing the distributed/checkpoint machinery into a request-path module
+# is the gateway violation — flag it at the import, where intent is clearest
+BANNED_IMPORT_PREFIXES = (
+    "metrics_tpu.parallel",
+    "metrics_tpu.checkpoint",
+    "jax.experimental.multihost_utils",
+)
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+@register_pass
+class ServeBlockingPass(AnalysisPass):
+    name = "serve-blocking"
+    description = (
+        "serve request-path modules spell no blocking collective, barrier, "
+        "KV wait, or checkpoint commit, and never import the distributed "
+        "machinery"
+    )
+
+    def applies(self, unit: ModuleUnit) -> bool:
+        return unit.rel.startswith(SCOPE_PREFIX)
+
+    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        problems: List[Finding] = []
+        for node, scope in walk_with_scope(unit.tree):
+            where = scope or "<module>"
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name in BLOCKING_CALLS:
+                    problems.append(
+                        self.finding(
+                            unit.rel,
+                            node.lineno,
+                            "blocking-call",
+                            f"{where}:{name}",
+                            f"`{name}(...)` can block on a peer; request paths "
+                            "must read local state only (move it to server.py's "
+                            "durability loop or an operator action)",
+                        )
+                    )
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                if isinstance(node, ast.Import):
+                    names = [a.name for a in node.names]
+                else:
+                    mod = node.module or ""
+                    names = [mod] + [f"{mod}.{a.name}" for a in node.names]
+                for name in names:
+                    if any(
+                        name == p or name.startswith(p + ".")
+                        for p in BANNED_IMPORT_PREFIXES
+                    ):
+                        problems.append(
+                            self.finding(
+                                unit.rel,
+                                node.lineno,
+                                "banned-import",
+                                f"{where}:{name}",
+                                f"imports `{name}`; the distributed/checkpoint "
+                                "machinery must stay out of request-path "
+                                "modules",
+                            )
+                        )
+        return problems

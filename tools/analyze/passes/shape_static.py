@@ -1,0 +1,146 @@
+"""shape-static: fixed-shape subsystems never use data-dependent shapes.
+
+The streaming, multistream, and serve subsystems' contract is fixed-shape
+state: a jitted ``update`` must never recompile as the stream grows, sketch
+states must pack into fixed-size sync blobs, ring buffers must rotate in
+place, and stacked ``(num_streams, ...)`` states must scatter without
+reshaping.  One stray ``jnp.nonzero`` / ``.item()`` / boolean-mask
+extraction silently breaks that — it traces fine in eager tests and then
+either crashes under jit or, worse, forces a retrace per batch.
+
+Scope is the package walk restricted to the fixed-shape directories —
+every module under ``metrics_tpu/streaming/``, ``metrics_tpu/multistream/``
+and ``metrics_tpu/serve/`` is covered by default; a deliberately-eager
+module opts out with ``# analyze: skip-file[shape-static] -- reason``.
+
+This pass is the ported ``tools/shape_lint.py`` (its module entry point
+remains as a shim).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleUnit,
+    register_pass,
+    walk_with_scope,
+)
+
+SCOPE_PREFIXES = (
+    "metrics_tpu/streaming/",
+    "metrics_tpu/multistream/",
+    # the serving path dispatches compiled blocks: the same static-shape
+    # discipline applies to everything between the queue and the metric
+    "metrics_tpu/serve/",
+)
+
+# call names whose result shape depends on data values
+DYNAMIC_SHAPE_CALLS = {
+    "nonzero",
+    "flatnonzero",
+    "argwhere",
+    "unique",
+    "unique_values",
+    "extract",
+    "compress",
+    "setdiff1d",
+    "union1d",
+    "intersect1d",
+}
+
+# host-pull methods that would put a device sync inside state math
+HOST_PULL_CALLS = {"item", "tolist"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+@register_pass
+class ShapeStaticPass(AnalysisPass):
+    name = "shape-static"
+    description = (
+        "streaming/multistream/serve state math stays fixed-shape: no "
+        "data-dependent-shape ops, host pulls, or growing state kinds"
+    )
+
+    def applies(self, unit: ModuleUnit) -> bool:
+        return unit.rel.startswith(SCOPE_PREFIXES)
+
+    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        problems: List[Finding] = []
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            where = scope or "<module>"
+            if name in DYNAMIC_SHAPE_CALLS:
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "dynamic-shape",
+                        f"{where}:{name}",
+                        f"`{name}` produces a data-dependent shape; streaming "
+                        "state must stay fixed-shape (mask with 3-arg `where` "
+                        "instead)",
+                    )
+                )
+            elif name == "where" and len(node.args) == 1 and not node.keywords:
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "where-indices",
+                        f"{where}:where",
+                        "single-argument `where` is data-dependent (returns "
+                        "indices); use the 3-argument select form",
+                    )
+                )
+            elif name in HOST_PULL_CALLS and isinstance(node.func, ast.Attribute):
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "host-pull",
+                        f"{where}:{name}",
+                        f"`.{name}()` forces a host round-trip inside streaming "
+                        "code; keep state math on device",
+                    )
+                )
+            elif name == "add_buffer_state":
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "buffer-state",
+                        f"{where}:add_buffer_state",
+                        "buffer states grow with the stream; streaming metrics "
+                        "must use fixed-shape tensor or sketch states",
+                    )
+                )
+            elif name == "add_state" and any(
+                isinstance(a, ast.List) and not a.elts for a in node.args
+            ):
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "list-state",
+                        f"{where}:add_state",
+                        "list-state default `[]` grows with the stream; "
+                        "streaming metrics must use fixed-shape tensor or "
+                        "sketch states",
+                    )
+                )
+        return problems

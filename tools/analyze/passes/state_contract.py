@@ -1,0 +1,289 @@
+"""state-contract: every state registration is internally consistent.
+
+``Metric.add_state`` / ``add_sketch_state`` / ``add_buffer_state`` calls
+carry three contracts this pass checks at the call site, across the whole
+package:
+
+* **reduce/default consistency** (rule ``reduce-default``) — a state's
+  default must be the identity of its ``dist_reduce_fx``: ``sum``/``mean``
+  states must not default to ones/aranges/nonzero constants (double-counted
+  on the first merge), ``max`` must not default to ``+inf`` and ``min`` to
+  ``-inf`` (the reduction can never move off an absorbing default), and a
+  list-state ``[]`` default only makes sense with ``cat`` gather semantics
+  (rule ``list-state-reduce``).
+* **sketch merge** (rule ``sketch-merge``) — ``add_sketch_state`` needs a
+  real ``merge_fn`` callable, not a literal.
+* **stackability** (rule ``stackable-growing-state``) — a metric class
+  annotated ``stackable = True`` (it promises to work as a
+  ``MultiStreamMetric`` base, where every state gains a leading
+  ``(num_streams, ...)`` axis) must not register list or buffer states:
+  growing states have no fixed-shape per-stream stacked form, so the
+  annotation and the registration contradict each other.
+* **serializer coverage** (rules shared with ``ckpt-serializers``) — every
+  registration API's kinds are declared to the checkpoint codec; this
+  absorbs the old ``ckpt_lint`` static half so one pass owns the
+  state-registration contract end to end.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, List, Optional
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleUnit,
+    register_pass,
+)
+
+_METRIC_REL = "metrics_tpu/metric.py"
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_inf(node: ast.AST, unit: ModuleUnit, sign: int) -> bool:
+    """Whether ``node`` is statically ±inf (``jnp.inf``, ``float('inf')``,
+    ``math.inf`` and friends), with ``sign`` +1 or -1."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_inf(node.operand, unit, -sign)
+    if isinstance(node, ast.Call):
+        fn = unit.resolve(node.func) or ""
+        if fn in ("builtins.float", "float") and node.args:
+            val = _const_str(node.args[0])
+            if val is None:
+                return False
+            val = val.lower().lstrip("+")
+            if val.startswith("-"):
+                return sign < 0 and val[1:] in ("inf", "infinity")
+            return sign > 0 and val in ("inf", "infinity")
+        tail = fn.rsplit(".", 1)[-1]
+        # full(shape, inf) / full_like(x, inf) / asarray(inf) / array(inf)
+        if tail in ("full", "full_like") and len(node.args) >= 2:
+            return _is_inf(node.args[1], unit, sign)
+        if tail in ("asarray", "array") and node.args:
+            return _is_inf(node.args[0], unit, sign)
+    if sign < 0:
+        return False
+    resolved = unit.resolve(node)
+    if resolved in ("jax.numpy.inf", "numpy.inf", "math.inf"):
+        return True
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return node.value == float("inf")
+    return False
+
+
+def _is_nonzero(node: ast.AST, unit: ModuleUnit) -> bool:
+    """Whether ``node`` is statically a provably-nonzero default."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value != 0
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_nonzero(node.operand, unit)
+    if isinstance(node, ast.Call):
+        fn = (unit.resolve(node.func) or "").rsplit(".", 1)[-1]
+        if fn in ("ones", "ones_like", "arange"):
+            return True
+        if fn in ("full", "full_like") and len(node.args) >= 2:
+            return _is_nonzero(node.args[1], unit) or _is_inf(node.args[1], unit, 1) or _is_inf(
+                node.args[1], unit, -1
+            )
+        if fn in ("asarray", "array") and node.args:
+            return _is_nonzero(node.args[0], unit)
+    return False
+
+
+@register_pass
+class StateContractPass(AnalysisPass):
+    name = "state-contract"
+    description = (
+        "add_state/add_sketch_state registrations keep dist_reduce kind, "
+        "default value, stackability annotation, and checkpoint-serializer "
+        "coverage consistent"
+    )
+
+    # ------------------------------------------------------------ per module
+    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        problems: List[Finding] = []
+        self._check_calls(unit, problems)
+        self._check_stackability(unit, problems)
+        return problems
+
+    def _check_calls(self, unit: ModuleUnit, problems: List[Finding]) -> None:
+        from tools.analyze.engine import walk_with_scope
+
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else ""
+            )
+            where = scope or "<module>"
+            if name == "add_state":
+                self._check_add_state(unit, node, where, problems)
+            elif name == "add_sketch_state":
+                merge = node.args[2] if len(node.args) > 2 else _kwarg(node, "merge_fn")
+                if isinstance(merge, ast.Constant):
+                    problems.append(
+                        self.finding(
+                            unit.rel,
+                            node.lineno,
+                            "sketch-merge",
+                            f"{where}:add_sketch_state",
+                            "`add_sketch_state` needs a callable `merge_fn` "
+                            f"(got the literal {merge.value!r}); sketch sync "
+                            "folds gathered trees through it",
+                        )
+                    )
+
+    def _check_add_state(
+        self, unit: ModuleUnit, node: ast.Call, where: str, problems: List[Finding]
+    ) -> None:
+        if len(node.args) < 2:
+            return
+        state_name = _const_str(node.args[0]) or "<dynamic>"
+        default = node.args[1]
+        reduce_node = node.args[2] if len(node.args) > 2 else _kwarg(node, "dist_reduce_fx")
+        reduce_fx = _const_str(reduce_node)
+        detail = f"{where}:{state_name}"
+        if isinstance(default, ast.List) and not default.elts:
+            if reduce_fx is not None and reduce_fx != "cat":
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "list-state-reduce",
+                        detail,
+                        f"list state {state_name!r} defaults to `[]` (gathered "
+                        f"with cat semantics) but declares dist_reduce_fx="
+                        f"{reduce_fx!r} — rows would be reduced, not "
+                        "concatenated",
+                    )
+                )
+            return
+        if reduce_fx in ("sum", "mean") and _is_nonzero(default, unit):
+            problems.append(
+                self.finding(
+                    unit.rel,
+                    node.lineno,
+                    "reduce-default",
+                    detail,
+                    f"state {state_name!r} declares dist_reduce_fx="
+                    f"{reduce_fx!r} but its default is provably nonzero — the "
+                    "default is folded into every merge/sync, double-counting "
+                    "from the first reduction",
+                )
+            )
+        elif reduce_fx == "max" and _is_inf(default, unit, +1):
+            problems.append(
+                self.finding(
+                    unit.rel,
+                    node.lineno,
+                    "reduce-default",
+                    detail,
+                    f"state {state_name!r} reduces with `max` but defaults to "
+                    "+inf — an absorbing default: the state can never move, "
+                    "use -inf (the max identity)",
+                )
+            )
+        elif reduce_fx == "min" and _is_inf(default, unit, -1):
+            problems.append(
+                self.finding(
+                    unit.rel,
+                    node.lineno,
+                    "reduce-default",
+                    detail,
+                    f"state {state_name!r} reduces with `min` but defaults to "
+                    "-inf — an absorbing default: the state can never move, "
+                    "use +inf (the min identity)",
+                )
+            )
+
+    # --------------------------------------------------------- stackability
+    def _check_stackability(self, unit: ModuleUnit, problems: List[Finding]) -> None:
+        for node in ast.walk(unit.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            stackable = None
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "stackable"
+                        for t in stmt.targets
+                    )
+                    and isinstance(stmt.value, ast.Constant)
+                ):
+                    stackable = stmt.value.value
+                elif (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.target.id == "stackable"
+                    and isinstance(stmt.value, ast.Constant)
+                ):
+                    stackable = stmt.value.value
+            if stackable is not True:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                cname = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else ""
+                )
+                growing = cname == "add_buffer_state" or (
+                    cname == "add_state"
+                    and len(sub.args) >= 2
+                    and isinstance(sub.args[1], ast.List)
+                    and not sub.args[1].elts
+                )
+                if growing:
+                    problems.append(
+                        self.finding(
+                            unit.rel,
+                            sub.lineno,
+                            "stackable-growing-state",
+                            f"{node.name}:{cname}",
+                            f"class {node.name} declares `stackable = True` "
+                            "(usable as a MultiStreamMetric base) but registers "
+                            f"a growing state via `{cname}` — growing states "
+                            "have no fixed-shape (num_streams, ...) stacked "
+                            "form; use tensor or sketch states, or drop the "
+                            "annotation",
+                        )
+                    )
+
+    # ------------------------------------------------- serializer coverage
+    def finish(self, ctx: AnalysisContext) -> List[Finding]:
+        if ctx.scratch.get("fixture_mode"):
+            return []  # fixture runs check source snippets, not the live codec
+        from tools.analyze.passes.ckpt_serializers import coverage_problems
+
+        try:
+            rows = coverage_problems()
+        except Exception as err:  # the package must import for this half
+            return [
+                self.finding(
+                    _METRIC_REL,
+                    0,
+                    "coverage-unavailable",
+                    "import",
+                    f"could not check serializer coverage: {type(err).__name__}: {err}",
+                )
+            ]
+        return [
+            self.finding(_METRIC_REL, 0, rule, detail, message)
+            for rule, detail, message in rows
+        ]

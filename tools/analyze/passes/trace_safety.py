@@ -1,0 +1,485 @@
+"""trace-safety: no host round-trips or Python control flow on traced values.
+
+The library's perf story rests on jitted state math: ``update`` / ``compute``
+/ the sync transport all trace once and then dispatch.  A ``.item()``, a
+``float(...)`` cast, an ``np.asarray`` of a device array, or a Python
+``if``/``while`` on a traced value inside that region either crashes under
+jit (``TracerBoolConversionError``) or — worse — silently forces a host
+sync or a retrace per batch.  This pass generalizes the old streaming-only
+shape lint to the whole package:
+
+1. **traced-region discovery** — a function is traced when it is decorated
+   with ``jit``/``pjit`` (directly or through ``functools.partial``), passed
+   to a ``jax.jit`` / ``pjit`` / ``vmap`` / ``pmap`` / ``lax.cond`` /
+   ``lax.scan`` / ``lax.while_loop`` / ... call site (by name, ``self.``
+   attribute, or inline lambda), or statically reachable from such a
+   function through same-module calls;
+2. **host round-trips** inside traced regions: ``.item()`` / ``.tolist()``
+   (rule ``host-pull``), ``float()``/``int()``/``bool()`` casts of
+   non-constant values (rule ``host-cast`` — shape/ndim/size/len reads are
+   exempt, those are static under trace), and host-numpy ``asarray`` /
+   ``array`` calls where the **import graph** says the alias is real
+   ``numpy``, not ``jax.numpy`` (rule ``numpy-in-trace``);
+3. **Python control flow on traced values** (rule ``traced-branch``,
+   severity ``warning``): an ``if``/``while`` whose test reads a function
+   parameter or a value produced by a ``jax.numpy``/``jax.lax`` call —
+   parameters with literal defaults or scalar annotations are treated as
+   static configuration, and ``is None`` / ``isinstance`` / shape reads are
+   exempt.
+
+Deliberately-eager paths (the detection host kernels, the native ctypes
+shims, serve I/O) are allowlisted below; one-off eager lines inside traced
+modules use ``# analyze: ignore[trace-safety]`` with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    ModuleUnit,
+    register_pass,
+)
+
+# resolved dotted name -> positional indices that are functions-to-trace.
+# Positions matter: ``lax.scan(body, state, xs)`` traces only ``body`` —
+# marking operand names too would alias unrelated same-named defs (e.g. a
+# ``state`` carry colliding with a ``state`` property).
+FUNC_ARG_POSITIONS = {
+    "jax.jit": (0,),
+    "jax.pjit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.experimental.pjit.pjit": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.jvp": (0,),
+    "jax.vjp": (0,),
+    "jax.linearize": (0,),
+}
+
+# keyword spellings of those function arguments
+FUNC_KWARG_NAMES = {"fun", "f", "body_fun", "cond_fun", "true_fun", "false_fun", "branches"}
+
+TRACE_WRAPPERS = frozenset(FUNC_ARG_POSITIONS)
+
+# resolved call prefixes that produce traced/device values
+DEVICE_NAMESPACES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.random.",
+    "jax.scipy.",
+)
+
+# host-numpy functions that force a device->host transfer of a traced value
+NUMPY_HOST_CALLS = {"asarray", "array", "ascontiguousarray", "copy", "frombuffer"}
+
+HOST_PULL_CALLS = {"item", "tolist"}
+HOST_CASTS = {"float", "int", "bool", "complex"}
+
+# static-under-trace attribute reads: casting these is fine
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "weak_type", "itemsize"}
+
+SCALAR_ANNOTATIONS = {"int", "bool", "str", "bytes"}
+
+# deliberately-eager module prefixes: host kernels and serve/O — the paths
+# that are eager BY DESIGN (finer-grained opt-outs use skip-file markers)
+EAGER_ALLOWLIST = (
+    "metrics_tpu/detection/",  # COCO matching runs as host kernels (numpy/ctypes)
+    "metrics_tpu/_native/",  # ctypes build + host shims
+    "metrics_tpu/serve/httpd.py",  # HTTP I/O is host-side by definition
+    "metrics_tpu/serve/soak.py",  # soak harness drives the server eagerly
+    "metrics_tpu/serve/traffic.py",  # traffic generator is host-side
+)
+
+
+class _FnInfo:
+    __slots__ = ("node", "qualname", "cls", "simple")
+
+    def __init__(self, node: ast.AST, qualname: str, cls: Optional[str]) -> None:
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+        self.simple = qualname.rsplit(".", 1)[-1]
+
+
+def _collect_functions(tree: ast.Module) -> List[_FnInfo]:
+    out: List[_FnInfo] = []
+
+    def visit(node: ast.AST, scope: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{scope}.{child.name}" if scope else child.name
+                out.append(_FnInfo(child, qual, cls))
+                visit(child, qual, None)
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{scope}.{child.name}" if scope else child.name
+                visit(child, qual, qual)
+            elif isinstance(child, ast.Lambda):
+                qual = f"{scope}.<lambda@{child.lineno}>" if scope else f"<lambda@{child.lineno}>"
+                out.append(_FnInfo(child, qual, cls))
+                visit(child, qual, None)
+            else:
+                visit(child, scope, cls)
+
+    visit(tree, "", None)
+    return out
+
+
+def _body_nodes(fn: ast.AST):
+    """Walk a function's own body, not descending into nested defs/lambdas."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def _contains(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+def _is_static_read(expr: ast.AST) -> bool:
+    """shape/len/dtype reads are static under trace — casting them is fine."""
+    return _contains(
+        expr,
+        lambda n: (isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS)
+        or (isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id == "len"),
+    )
+
+
+def _param_names(fn: ast.AST) -> List[Tuple[str, Optional[ast.AST], Optional[ast.AST]]]:
+    """``(name, default, annotation)`` for every positional/kw-only param."""
+    a = fn.args
+    out: List[Tuple[str, Optional[ast.AST], Optional[ast.AST]]] = []
+    pos = list(a.posonlyargs) + list(a.args)
+    defaults: List[Optional[ast.AST]] = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+    for arg, default in zip(pos, defaults):
+        out.append((arg.arg, default, arg.annotation))
+    for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+        out.append((arg.arg, default, arg.annotation))
+    return out
+
+
+def _is_literal_default(default: Optional[ast.AST]) -> bool:
+    if default is None:
+        return False
+    if isinstance(default, ast.Constant):
+        return True
+    if isinstance(default, ast.UnaryOp) and isinstance(default.operand, ast.Constant):
+        return True
+    return False
+
+
+def _scalar_annotation(annotation: Optional[ast.AST]) -> bool:
+    if annotation is None:
+        return False
+    names = {n.id for n in ast.walk(annotation) if isinstance(n, ast.Name)}
+    return bool(names & SCALAR_ANNOTATIONS) and not (names - SCALAR_ANNOTATIONS - {"Optional"})
+
+
+def _static_argnames(fn: ast.AST, unit: ModuleUnit) -> Set[str]:
+    """Params pinned static by a jit decorator's static_argnames/argnums."""
+    out: Set[str] = set()
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return out
+    params = [name for name, _d, _a in _param_names(fn)]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        resolved = unit.resolve(dec.func)
+        is_jit = resolved in TRACE_WRAPPERS or (
+            resolved == "functools.partial"
+            and dec.args
+            and unit.resolve(dec.args[0]) in TRACE_WRAPPERS
+        )
+        if not is_jit:
+            continue
+        for kw in dec.keywords:
+            if kw.arg not in ("static_argnames", "static_argnums"):
+                continue
+            values = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in values:
+                if not isinstance(v, ast.Constant):
+                    continue
+                if isinstance(v.value, str):
+                    out.add(v.value)
+                elif isinstance(v.value, int) and 0 <= v.value < len(params):
+                    out.add(params[v.value])
+    return out
+
+
+def _arrayish_names(fn: ast.AST, unit: ModuleUnit) -> Set[str]:
+    """Names in ``fn`` that plausibly hold traced values: non-config
+    parameters plus names assigned from jax namespace calls."""
+    names: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        static = _static_argnames(fn, unit)
+        for name, default, annotation in _param_names(fn):
+            if name in ("self", "cls") or name in static:
+                continue
+            if _is_literal_default(default) or _scalar_annotation(annotation):
+                continue  # static configuration, not data
+            names.add(name)
+    for node in _body_nodes(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            resolved = unit.resolve(node.value.func)
+            if resolved and resolved.startswith(DEVICE_NAMESPACES):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        names.add(target.id)
+    return names
+
+
+def _test_is_exempt(test: ast.AST) -> bool:
+    """``is None`` / isinstance / shape reads etc. are static predicates."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in n.ops
+        ):
+            return True
+        if isinstance(n, ast.Compare) and any(
+            isinstance(c, ast.Constant) and isinstance(c.value, str)
+            for c in [n.left] + list(n.comparators)
+        ):
+            return True  # comparing against a string: mode/kind dispatch
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id in (
+            "isinstance",
+            "len",
+            "hasattr",
+            "getattr",
+            "callable",
+        ):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return True
+    return False
+
+
+@register_pass
+class TraceSafetyPass(AnalysisPass):
+    name = "trace-safety"
+    description = (
+        "functions reachable from jit/pjit/vmap call sites contain no host "
+        "round-trips (.item()/float()/np.asarray) or Python branches on "
+        "traced values"
+    )
+
+    def applies(self, unit: ModuleUnit) -> bool:
+        return not unit.rel.startswith(EAGER_ALLOWLIST)
+
+    # ------------------------------------------------------------ discovery
+    def _traced_functions(self, unit: ModuleUnit) -> Dict[str, _FnInfo]:
+        tree = unit.tree
+        fns = _collect_functions(tree)
+        by_node = {id(f.node): f for f in fns}
+        by_simple: Dict[str, List[_FnInfo]] = {}
+        for f in fns:
+            by_simple.setdefault(f.simple, []).append(f)
+
+        roots: Set[str] = set()
+
+        def mark_name(name: str) -> None:
+            for f in by_simple.get(name, []):
+                roots.add(f.qualname)
+
+        def mark_arg(arg: ast.AST) -> None:
+            if isinstance(arg, ast.Name):
+                mark_name(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                mark_name(arg.attr)  # self.fn / obj.fn — match by method name
+            elif isinstance(arg, ast.Lambda):
+                info = by_node.get(id(arg))
+                if info is not None:
+                    roots.add(info.qualname)
+            elif isinstance(arg, (ast.List, ast.Tuple)):  # lax.switch branches
+                for elt in arg.elts:
+                    mark_arg(elt)
+
+        # decorators
+        for f in fns:
+            if not isinstance(f.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in f.node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                resolved = unit.resolve(target)
+                if resolved in TRACE_WRAPPERS:
+                    roots.add(f.qualname)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and resolved == "functools.partial"
+                    and dec.args
+                    and unit.resolve(dec.args[0]) in TRACE_WRAPPERS
+                ):
+                    roots.add(f.qualname)
+
+        # call sites: jax.jit(fn), lax.cond(p, true_fn, false_fn, ...) etc. —
+        # only the function-position arguments, never the operands
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            positions = FUNC_ARG_POSITIONS.get(unit.resolve(node.func) or "")
+            if positions is None:
+                continue
+            for i in positions:
+                if i < len(node.args):
+                    mark_arg(node.args[i])
+            for kw in node.keywords:
+                if kw.arg in FUNC_KWARG_NAMES and kw.value is not None:
+                    mark_arg(kw.value)
+
+        # same-module reachability: a fn called from a traced fn is traced
+        edges: Dict[str, Set[str]] = {f.qualname: set() for f in fns}
+        for f in fns:
+            for node in _body_nodes(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name):
+                    for g in by_simple.get(node.func.id, []):
+                        edges[f.qualname].add(g.qualname)
+                elif isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ) and node.func.value.id in ("self", "cls"):
+                    for g in by_simple.get(node.func.attr, []):
+                        if g.cls is not None and g.cls == f.cls:
+                            edges[f.qualname].add(g.qualname)
+
+        traced: Set[str] = set()
+        frontier = list(roots)
+        while frontier:
+            qual = frontier.pop()
+            if qual in traced:
+                continue
+            traced.add(qual)
+            frontier.extend(edges.get(qual, ()))
+        return {f.qualname: f for f in fns if f.qualname in traced}
+
+    # -------------------------------------------------------------- checks
+    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        traced = self._traced_functions(unit)
+        if not traced:
+            return []
+        problems: List[Finding] = []
+        for qual, info in sorted(traced.items()):
+            arrayish = _arrayish_names(info.node, unit)
+            for node in _body_nodes(info.node):
+                if isinstance(node, ast.Call):
+                    problems.extend(self._check_call(unit, qual, node, arrayish))
+                elif isinstance(node, (ast.If, ast.While)):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    test = node.test
+                    if _test_is_exempt(test):
+                        continue
+                    used = {
+                        n.id for n in ast.walk(test) if isinstance(n, ast.Name)
+                    } & arrayish
+                    if used:
+                        problems.append(
+                            self.finding(
+                                unit.rel,
+                                node.lineno,
+                                "traced-branch",
+                                f"{qual}:{kind}:{'/'.join(sorted(used))}",
+                                f"Python `{kind}` on {sorted(used)} inside traced "
+                                f"function `{qual}` — a traced value here raises "
+                                "under jit or forces a host sync; use "
+                                "`jax.lax.cond`/`where` (or mark the value "
+                                "static)",
+                                severity="warning",
+                            )
+                        )
+        return problems
+
+    @staticmethod
+    def _arg_is_arrayish(unit: ModuleUnit, arg: ast.AST, arrayish: Set[str]) -> bool:
+        """The cast argument plausibly holds a traced value: it mentions an
+        arrayish name or contains a device-namespace call."""
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Name) and n.id in arrayish:
+                return True
+            if isinstance(n, ast.Call):
+                resolved = unit.resolve(n.func)
+                if resolved and resolved.startswith(DEVICE_NAMESPACES):
+                    return True
+        return False
+
+    def _check_call(
+        self, unit: ModuleUnit, qual: str, node: ast.Call, arrayish: Set[str]
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in HOST_PULL_CALLS:
+            out.append(
+                self.finding(
+                    unit.rel,
+                    node.lineno,
+                    "host-pull",
+                    f"{qual}:{fn.attr}",
+                    f"`.{fn.attr}()` inside traced function `{qual}` forces a "
+                    "device->host round-trip (and raises under jit)",
+                )
+            )
+        elif (
+            isinstance(fn, ast.Name)
+            and fn.id in HOST_CASTS
+            and fn.id not in unit.imports  # a local alias shadows the builtin
+            and len(node.args) == 1
+            and not node.keywords
+            and not isinstance(node.args[0], ast.Constant)
+            and not _is_static_read(node.args[0])
+            and self._arg_is_arrayish(unit, node.args[0], arrayish)
+        ):
+            out.append(
+                self.finding(
+                    unit.rel,
+                    node.lineno,
+                    "host-cast",
+                    f"{qual}:{fn.id}",
+                    f"`{fn.id}(...)` of a non-static value inside traced "
+                    f"function `{qual}` concretizes a tracer (raises under "
+                    "jit); keep the value on device or read a static "
+                    "shape/dtype instead",
+                )
+            )
+        else:
+            resolved = unit.resolve(fn)
+            if resolved is not None:
+                head, _, tail = resolved.rpartition(".")
+                if head == "numpy" and tail in NUMPY_HOST_CALLS:
+                    out.append(
+                        self.finding(
+                            unit.rel,
+                            node.lineno,
+                            "numpy-in-trace",
+                            f"{qual}:numpy.{tail}",
+                            f"host `numpy.{tail}` inside traced function "
+                            f"`{qual}` pulls a traced array to the host; use "
+                            "`jax.numpy` (check the import alias) or move the "
+                            "call out of the traced region",
+                        )
+                    )
+        return out

@@ -1,131 +1,45 @@
-"""Static check: every registered state kind has a checkpoint serializer.
+"""Checkpoint-serializer coverage lint — thin shim over ``tools.analyze``.
 
-The checkpoint codec (``metrics_tpu/checkpoint/codec.py``) serializes metric
-state BY KIND — the ``SERIALIZERS`` registry maps each kind reported by
-``Metric.state_kinds()`` to its pack/unpack/merge path.  A new state
-registration API (``add_*_state``) or a new kind that lands without a codec
-entry would silently produce checkpoints that drop that state, or restores
-that KeyError in production.  This linter pins the three surfaces together:
-
-1. every ``add*_state`` method on ``Metric`` appears in
-   ``STATE_KIND_REGISTRARS`` (new registration APIs must declare their kinds);
-2. every kind named by ``STATE_KIND_REGISTRARS`` has a ``SERIALIZERS`` entry;
-3. every kind ``state_kinds()`` can emit — probed by instantiating one
-   exemplar metric per kind — round-trips through ``encode_metric`` /
-   ``decode_metric`` with digests verifying.
-
-Run directly (``python tools/ckpt_lint.py``) or via
-``tests/test_ckpt_lint.py`` as a tier-1 gate.
+The checks live in the ``ckpt-serializers`` pass
+(``tools/analyze/passes/ckpt_serializers.py``); this module keeps the
+legacy entry point and API (``lint`` / ``lint_roundtrip``) alive.  Prefer
+``python -m tools.analyze``.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import List
 
-# allow running from a checkout without installing the package
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO_ROOT not in sys.path:
-    sys.path.insert(0, _REPO_ROOT)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # imported by bare name with tools/ on sys.path
+    sys.path.insert(0, _REPO)
 
-_REGISTRAR_RE = re.compile(r"^add[a-z_]*_state$")
+from tools.analyze.passes.ckpt_serializers import (
+    coverage_problems,
+    roundtrip_problems,
+)
 
 
 def lint() -> List[str]:
-    """Return a list of violation strings; empty means the codec is complete."""
-    from metrics_tpu.checkpoint.codec import META_STATE, SERIALIZERS, STATE_KIND_REGISTRARS
-    from metrics_tpu.metric import Metric
-
-    problems: List[str] = []
-
-    # 1. every state-registration API on Metric is declared
-    registrars = sorted(
-        name
-        for name in vars(Metric)
-        if _REGISTRAR_RE.match(name) and callable(getattr(Metric, name))
-    )
-    for name in registrars:
-        if name not in STATE_KIND_REGISTRARS:
-            problems.append(
-                f"Metric.{name}() registers state but is missing from "
-                "checkpoint.codec.STATE_KIND_REGISTRARS — declare which codec "
-                "kind(s) it produces so checkpoints cover it."
-            )
-    for name in STATE_KIND_REGISTRARS:
-        if name not in registrars:
-            problems.append(
-                f"checkpoint.codec.STATE_KIND_REGISTRARS names {name!r} but "
-                "Metric has no such registration method — stale entry."
-            )
-
-    # 2. every declared kind has a serializer
-    declared = {k for kinds in STATE_KIND_REGISTRARS.values() for k in kinds}
-    for kind in sorted(declared):
-        if kind not in SERIALIZERS:
-            problems.append(
-                f"state kind {kind!r} (declared in STATE_KIND_REGISTRARS) has "
-                "no checkpoint.codec.SERIALIZERS entry — it would be dropped "
-                "from every checkpoint."
-            )
-    for kind in SERIALIZERS:
-        if kind != META_STATE and kind not in declared:
-            problems.append(
-                f"checkpoint.codec.SERIALIZERS entry {kind!r} is produced by "
-                "no registration API in STATE_KIND_REGISTRARS — stale entry."
-            )
-    return problems
+    return [message for _rule, _detail, message in coverage_problems()]
 
 
 def lint_roundtrip() -> List[str]:
-    """Probe one exemplar metric per kind through an encode/decode cycle."""
-    import jax.numpy as jnp
-
-    import metrics_tpu as mt
-    from metrics_tpu.checkpoint.codec import decode_metric, encode_metric
-
-    exemplars = {
-        "tensor": (mt.MeanMetric(), lambda m: m.update(jnp.arange(4.0))),
-        "list": (mt.CatMetric(), lambda m: m.update(jnp.arange(4.0))),
-        "buffer": (mt.AUROC(), lambda m: m.update(jnp.asarray([0.1, 0.8, 0.4, 0.9]), jnp.asarray([0, 1, 0, 1]))),
-        "sketch": (mt.StreamingQuantile(), lambda m: m.update(jnp.arange(32.0))),
-    }
-    problems: List[str] = []
-    for kind, (metric, feed) in exemplars.items():
-        feed(metric)
-        kinds = set(metric.state_kinds().values())
-        if kind not in kinds:
-            problems.append(
-                f"exemplar for kind {kind!r} ({type(metric).__name__}) reports "
-                f"kinds {sorted(kinds)} — update the exemplar table."
-            )
-            continue
-        enc = encode_metric(metric)
-        dec = decode_metric(enc.blob, enc.digests)
-        if dec.failed:
-            problems.append(
-                f"kind {kind!r} ({type(metric).__name__}) failed its own "
-                f"encode/decode round trip: state(s) {dec.failed} did not verify."
-            )
-        missing = set(enc.digests) - set(dec.arrays) - set(dec.failed)
-        if missing:
-            problems.append(
-                f"kind {kind!r} round trip silently lost state(s) {sorted(missing)}."
-            )
-    return problems
+    return [message for _rule, _detail, message in roundtrip_problems()]
 
 
 def main() -> int:
     problems = lint() + lint_roundtrip()
-    for line in problems:
-        print(f"ckpt_lint: {line}", file=sys.stderr)
+    for p in problems:
+        print(p)
     if problems:
-        print(f"ckpt_lint: {len(problems)} violation(s)", file=sys.stderr)
+        print(f"ckpt_lint: {len(problems)} problem(s)")
         return 1
-    print("ckpt_lint: every state kind has a verified checkpoint path")
+    print("ckpt_lint: clean")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())

@@ -1,93 +1,42 @@
-"""Static check: every Metric subclass stays on the instrumented base-class path.
+"""Obs-instrumentation lint — thin shim over ``tools.analyze``.
 
-The obs spans/counters in ``metrics_tpu.obs`` are attached once, in
-``Metric._update_wrapper`` / ``Metric._compute_wrapper`` / ``Metric.sync`` /
-``Metric._finish_sync_report``.  A subclass that shadows one of those in its
-class dict silently drops out of the telemetry (no update/compute spans, no
-sync report recording) — which is exactly the kind of regression that never
-shows up in functional tests.  This linter imports ``metrics_tpu``, walks the
-full ``Metric`` subclass tree, and reports any first-party subclass that
-overrides an instrumented method without being allowlisted.
-
-Run directly (``python tools/obs_lint.py``) or via ``tests/test_obs_lint.py``.
+The check lives in the ``obs-instrumentation`` pass
+(``tools/analyze/passes/obs_instrumentation.py``); this module keeps the
+legacy entry point and API alive.  Prefer ``python -m tools.analyze``.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-from typing import Dict, List, Set, Tuple, Type
+from typing import List
 
-# allow running from a checkout without installing the package
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO_ROOT not in sys.path:
-    sys.path.insert(0, _REPO_ROOT)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # imported by bare name with tools/ on sys.path
+    sys.path.insert(0, _REPO)
 
-# Methods that carry the instrumentation; overriding any of them in a class
-# dict bypasses spans, recompile counters, or sync-report recording.
-INSTRUMENTED_METHODS: Tuple[str, ...] = (
-    "_update_wrapper",
-    "_compute_wrapper",
-    "_install_wrappers",
-    "sync",
-    "_finish_sync_report",
+from tools.analyze import run_passes
+from tools.analyze.passes.obs_instrumentation import (  # noqa: F401  (legacy API)
+    ALLOWLIST,
+    INSTRUMENTED_METHODS,
 )
-
-# (qualified class name) -> methods it may override.  CompositionalMetric
-# re-dispatches through its operand metrics, each of which is spanned
-# individually, so its wrapper overrides do not lose telemetry.
-# MultiStreamMetric extends _finish_sync_report via super() to attribute
-# stacked-state sync traffic to the multistream.sync_bytes counter — the
-# base recording still runs first.
-ALLOWLIST: Dict[str, Set[str]] = {
-    "metrics_tpu.metric.CompositionalMetric": {"_update_wrapper", "_compute_wrapper"},
-    "metrics_tpu.multistream.core.MultiStreamMetric": {"_finish_sync_report"},
-}
-
-
-def _walk_subclasses(cls: Type) -> List[Type]:
-    out: List[Type] = []
-    for sub in cls.__subclasses__():
-        out.append(sub)
-        out.extend(_walk_subclasses(sub))
-    return out
 
 
 def lint() -> List[str]:
-    """Return a list of violation strings; empty means the tree is clean."""
-    import metrics_tpu  # noqa: F401  (populates the subclass tree)
-    from metrics_tpu.metric import Metric
-
-    problems: List[str] = []
-    seen: Set[Type] = set()
-    for sub in _walk_subclasses(Metric):
-        if sub in seen:
-            continue
-        seen.add(sub)
-        if not sub.__module__.startswith("metrics_tpu"):
-            continue  # user-defined subclasses are out of scope
-        qualname = f"{sub.__module__}.{sub.__name__}"
-        allowed = ALLOWLIST.get(qualname, set())
-        for method in INSTRUMENTED_METHODS:
-            if method in sub.__dict__ and method not in allowed:
-                problems.append(
-                    f"{qualname} overrides {method}(); it will bypass obs "
-                    "instrumentation. Override update()/compute() instead, or "
-                    "add an explicit allowlist entry in tools/obs_lint.py."
-                )
-    return problems
+    report = run_passes(["obs-instrumentation"], baseline_path=None)
+    return [f.render() for f in report.findings]
 
 
 def main() -> int:
     problems = lint()
-    for line in problems:
-        print(f"obs_lint: {line}", file=sys.stderr)
+    for p in problems:
+        print(p)
     if problems:
-        print(f"obs_lint: {len(problems)} violation(s)", file=sys.stderr)
+        print(f"obs_lint: {len(problems)} problem(s)")
         return 1
-    print("obs_lint: all Metric subclasses on the instrumented path")
+    print("obs_lint: clean")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())

@@ -1,148 +1,66 @@
-"""Static check: serve request paths never block on a collective or KV wait.
+"""Serve request-path blocking lint — thin shim over ``tools.analyze``.
 
-The serve layer's responsiveness claim is structural: an HTTP handler or
-the queue-consumer loop must never sit in a mesh collective, a distributed
-barrier, or a parked key-value wait, because a peer that died (or a
-scheduler that paused it) would turn one slow tenant read into a hung
-service.  ``MetricRegistry.register`` enforces the dynamic half (it forces
-``sync_on_compute`` / ``dist_sync_on_step`` off); this linter enforces the
-static half: the request-path modules simply do not *spell* any blocking
-primitive.
-
-AST-walked modules — everything that runs on an HTTP thread or the
-consumer thread:
-
-* ``metrics_tpu/serve/httpd.py``
-* ``metrics_tpu/serve/ingest.py``
-* ``metrics_tpu/serve/registry.py``
-* ``metrics_tpu/serve/traffic.py``
-
-``server.py`` and ``soak.py`` are deliberately NOT linted: the durability
-loop checkpoints (which barriers across ranks by design) and the soak
-harness fires explicit operator syncs — both off the request path.
-
-Run directly (``python tools/serve_lint.py``) or via
-``tests/serve/test_serve_lint.py``.
+The checks live in the ``serve-blocking`` pass
+(``tools/analyze/passes/serve_blocking.py``); this module keeps the legacy
+entry point and API alive.  Scope is now the package walk (every
+``metrics_tpu/serve/`` module, opt-out via ``skip-file`` markers) instead of
+the old hand-maintained ``LINTED_MODULES`` tuple.  Prefer
+``python -m tools.analyze``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO_ROOT not in sys.path:
-    sys.path.insert(0, _REPO_ROOT)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # imported by bare name with tools/ on sys.path
+    sys.path.insert(0, _REPO)
 
-# request-path modules, relative to the repo root
-LINTED_MODULES = (
-    os.path.join("metrics_tpu", "serve", "httpd.py"),
-    os.path.join("metrics_tpu", "serve", "ingest.py"),
-    os.path.join("metrics_tpu", "serve", "registry.py"),
-    os.path.join("metrics_tpu", "serve", "traffic.py"),
-)
-
-# call names that block on peers: collectives, barriers, KV-store waits,
-# checkpoint commits (which barrier internally), and explicit metric syncs
-BLOCKING_CALLS = {
-    "sync",
-    "unsync",
-    "sync_context",
-    "wait_at_barrier",
-    "blocking_key_value_get",
-    "blocking_key_value_get_bytes",
-    "all_gather",
-    "all_gather_bytes",
-    "psum",
-    "pmean",
-    "pmax",
-    "pmin",
-    "preflight_check",
-    "save",
-    "save_now",
-    "maybe_save",
-    "restore",
-    "barrier",
-}
-
-# importing the distributed/checkpoint machinery into a request-path module
-# is the gateway violation — flag it at the import, where intent is clearest
-BANNED_IMPORT_PREFIXES = (
-    "metrics_tpu.parallel",
-    "metrics_tpu.checkpoint",
-    "jax.experimental.multihost_utils",
+from tools.analyze import analyze_source, discover_units, run_passes
+from tools.analyze.passes.serve_blocking import (  # noqa: F401  (legacy API)
+    BANNED_IMPORT_PREFIXES,
+    BLOCKING_CALLS,
+    SCOPE_PREFIX,
 )
 
 
-def _call_name(node: ast.Call) -> str:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return ""
+def _linted_modules() -> tuple:
+    """Discovery-backed replacement for the old hand-listed tuple."""
+    return tuple(
+        u.rel[len("metrics_tpu/"):]
+        for u in discover_units()
+        if u.rel.startswith(SCOPE_PREFIX) and not u.skips("serve-blocking")
+    )
+
+
+LINTED_MODULES = _linted_modules()
 
 
 def lint_source(src: str, filename: str) -> List[str]:
-    """Lint one request-path module's source; returns violation strings."""
-    problems: List[str] = []
-    try:
-        tree = ast.parse(src, filename=filename)
-    except SyntaxError as err:
-        return [f"{filename}:{err.lineno}: does not parse: {err.msg}"]
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            name = _call_name(node)
-            if name in BLOCKING_CALLS:
-                problems.append(
-                    f"{filename}:{node.lineno}: `{name}(...)` can block on a "
-                    "peer; request paths must read local state only (move it "
-                    "to server.py's durability loop or an operator action)"
-                )
-        elif isinstance(node, (ast.Import, ast.ImportFrom)):
-            if isinstance(node, ast.Import):
-                names = [a.name for a in node.names]
-            else:
-                mod = node.module or ""
-                names = [mod] + [f"{mod}.{a.name}" for a in node.names]
-            for name in names:
-                if any(
-                    name == p or name.startswith(p + ".")
-                    for p in BANNED_IMPORT_PREFIXES
-                ):
-                    problems.append(
-                        f"{filename}:{node.lineno}: imports `{name}`; the "
-                        "distributed/checkpoint machinery must stay out of "
-                        "request-path modules"
-                    )
-    return problems
+    """Lint one source string unconditionally (legacy behavior)."""
+    rel = filename.replace(os.sep, "/")
+    if not rel.startswith(SCOPE_PREFIX):
+        rel = SCOPE_PREFIX + os.path.basename(rel)
+    return [f.render() for f in analyze_source("serve-blocking", src, rel=rel)]
 
 
 def lint() -> List[str]:
-    """Lint every request-path serve module."""
-    problems: List[str] = []
-    for rel in LINTED_MODULES:
-        path = os.path.join(_REPO_ROOT, rel)
-        if not os.path.exists(path):
-            problems.append(f"{rel}: linted module is missing")
-            continue
-        with open(path, "r", encoding="utf-8") as fh:
-            problems.extend(lint_source(fh.read(), rel))
-    return problems
+    report = run_passes(["serve-blocking"], baseline_path=None)
+    return [f.render() for f in report.findings]
 
 
 def main() -> int:
     problems = lint()
-    for line in problems:
-        print(f"serve_lint: {line}", file=sys.stderr)
+    for p in problems:
+        print(p)
     if problems:
-        print(f"serve_lint: {len(problems)} violation(s)", file=sys.stderr)
+        print(f"serve_lint: {len(problems)} problem(s)")
         return 1
-    print("serve_lint: serve request paths are collective-free")
+    print("serve_lint: clean")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())

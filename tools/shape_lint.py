@@ -1,146 +1,55 @@
-"""Static check: fixed-shape subsystems never use data-dependent shapes.
+"""Static shape-discipline lint — thin shim over ``tools.analyze``.
 
-The streaming and multistream subsystems' whole contract is fixed-shape
-state: a jitted ``update`` must never recompile as the stream grows, sketch
-states must pack into fixed-size sync blobs, ring buffers must rotate in
-place, and stacked ``(num_streams, ...)`` states must scatter without
-reshaping.  One stray ``jnp.nonzero`` / ``.item()`` / boolean-mask
-extraction silently breaks that — it traces fine in eager tests and then
-either crashes under jit or, worse, forces a retrace per batch.
-
-This linter AST-walks every module under ``metrics_tpu/streaming/`` and
-``metrics_tpu/multistream/`` and flags:
-
-* calls producing data-dependent output shapes: ``nonzero``,
-  ``flatnonzero``, ``argwhere``, ``unique``, ``extract``, ``compress``,
-  ``repeat`` with array counts is out of scope (numpy-host only), and
-  single-argument ``where`` (the three-argument form is shape-static);
-* host round-trips inside state math: ``.item()`` / ``.tolist()`` on
-  computed values;
-* growing state kinds: any ``add_buffer_state`` call, or ``add_state`` with
-  a ``[]`` (list-state) default.
-
-Run directly (``python tools/shape_lint.py``) or via
-``tests/test_shape_lint.py``.
+The checks live in the ``shape-static`` pass
+(``tools/analyze/passes/shape_static.py``); this module keeps the legacy
+entry point (``python tools/shape_lint.py``) and API (``lint_source`` /
+``lint`` / ``LINTED_DIRS``) alive.  Prefer ``python -m tools.analyze``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if _REPO_ROOT not in sys.path:
-    sys.path.insert(0, _REPO_ROOT)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # imported by bare name with tools/ on sys.path
+    sys.path.insert(0, _REPO)
 
-STREAMING_DIR = os.path.join(_REPO_ROOT, "metrics_tpu", "streaming")
-
-# every directory whose modules must keep state math shape-static
-LINTED_DIRS = (
-    STREAMING_DIR,
-    os.path.join(_REPO_ROOT, "metrics_tpu", "multistream"),
-    # the serving path dispatches compiled blocks: the same static-shape
-    # discipline applies to everything between the queue and the metric
-    os.path.join(_REPO_ROOT, "metrics_tpu", "serve"),
+from tools.analyze import analyze_source, run_passes
+from tools.analyze.passes.shape_static import (  # noqa: F401  (legacy API)
+    DYNAMIC_SHAPE_CALLS,
+    HOST_PULL_CALLS,
+    SCOPE_PREFIXES,
 )
 
-# call names whose result shape depends on data values
-DYNAMIC_SHAPE_CALLS = {
-    "nonzero",
-    "flatnonzero",
-    "argwhere",
-    "unique",
-    "unique_values",
-    "extract",
-    "compress",
-    "setdiff1d",
-    "union1d",
-    "intersect1d",
-}
-
-# host-pull methods that would put a device sync inside state math
-HOST_PULL_CALLS = {"item", "tolist"}
-
-
-def _call_name(node: ast.Call) -> str:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return ""
+# legacy alias: scope now lives on the pass; discovery replaced the dir list
+LINTED_DIRS = tuple(p.split("/", 1)[1].rstrip("/") for p in SCOPE_PREFIXES)
 
 
 def lint_source(src: str, filename: str) -> List[str]:
-    """Lint one module's source; returns violation strings."""
-    problems: List[str] = []
-    try:
-        tree = ast.parse(src, filename=filename)
-    except SyntaxError as err:
-        return [f"{filename}:{err.lineno}: does not parse: {err.msg}"]
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        where = f"{filename}:{node.lineno}"
-        if name in DYNAMIC_SHAPE_CALLS:
-            problems.append(
-                f"{where}: `{name}` produces a data-dependent shape; streaming "
-                "state must stay fixed-shape (mask with 3-arg `where` instead)"
-            )
-        elif name == "where" and len(node.args) == 1 and not node.keywords:
-            problems.append(
-                f"{where}: single-argument `where` is data-dependent "
-                "(returns indices); use the 3-argument select form"
-            )
-        elif name in HOST_PULL_CALLS and isinstance(node.func, ast.Attribute):
-            problems.append(
-                f"{where}: `.{name}()` forces a host round-trip inside "
-                "streaming code; keep state math on device"
-            )
-        elif name == "add_buffer_state":
-            problems.append(
-                f"{where}: buffer states grow with the stream; streaming "
-                "metrics must use fixed-shape tensor or sketch states"
-            )
-        elif name == "add_state" and any(
-            isinstance(a, ast.List) and not a.elts for a in node.args
-        ):
-            problems.append(
-                f"{where}: list-state default `[]` grows with the stream; "
-                "streaming metrics must use fixed-shape tensor or sketch states"
-            )
-    return problems
+    """Lint one source string unconditionally (legacy behavior)."""
+    rel = filename.replace(os.sep, "/")
+    if not rel.startswith(SCOPE_PREFIXES):
+        rel = SCOPE_PREFIXES[0] + os.path.basename(rel)
+    return [f.render() for f in analyze_source("shape-static", src, rel=rel)]
 
 
 def lint() -> List[str]:
-    """Lint every module under the shape-static subsystem directories."""
-    problems: List[str] = []
-    for lint_dir in LINTED_DIRS:
-        for base, _dirs, files in sorted(os.walk(lint_dir)):
-            for fname in sorted(files):
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(base, fname)
-                with open(path, "r", encoding="utf-8") as fh:
-                    src = fh.read()
-                rel = os.path.relpath(path, _REPO_ROOT)
-                problems.extend(lint_source(src, rel))
-    return problems
+    report = run_passes(["shape-static"], baseline_path=None)
+    return [f.render() for f in report.findings]
 
 
 def main() -> int:
     problems = lint()
-    for line in problems:
-        print(f"shape_lint: {line}", file=sys.stderr)
+    for p in problems:
+        print(p)
     if problems:
-        print(f"shape_lint: {len(problems)} violation(s)", file=sys.stderr)
+        print(f"shape_lint: {len(problems)} problem(s)")
         return 1
-    print("shape_lint: streaming/, multistream/ and serve/ state is shape-static")
+    print("shape_lint: clean")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    raise SystemExit(main())
