@@ -28,6 +28,7 @@ METRIC_EPS = 1e-6
 
 
 class BinnedPrecisionRecallCurve(Metric):
+    stackable = True  # fixed (num_classes, num_thresholds) sum states
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

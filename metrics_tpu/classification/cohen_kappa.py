@@ -29,6 +29,8 @@ class CohenKappa(Metric):
         0.5
     """
 
+    stackable = True  # fixed (num_classes, num_classes) confmat sum state
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -32,6 +32,8 @@ class ConfusionMatrix(Metric):
                [1, 1]], dtype=int32)
     """
 
+    stackable = True  # fixed (num_classes, num_classes) confmat sum state
+
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

@@ -18,6 +18,8 @@ Array = jax.Array
 class HammingDistance(Metric):
     """Fraction of wrong labels across all predictions (lower is better)."""
 
+    stackable = True  # scalar sum states only; per-stream stacking is exact
+
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

@@ -29,6 +29,8 @@ class MatthewsCorrCoef(Metric):
         0.57735
     """
 
+    stackable = True  # fixed (num_classes, num_classes) confmat sum state
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -20,6 +20,7 @@ Array = jax.Array
 
 
 class _RankingBase(Metric):
+    stackable = True  # scalar sum states only; per-stream stacking is exact
     is_differentiable = False
     full_state_update = False
 

@@ -214,6 +214,13 @@ def jit_distributed_available() -> bool:
     return jax.process_count() > 1
 
 
+def _make_state_dict(owner: "Metric") -> Dict[str, Any]:
+    """Seam for the runtime state-race sanitizer (``tools/analyze/runtime``):
+    it swaps this for a factory returning a write-recording dict, so every
+    ``_state`` write carries thread/lockset context during a witnessed run."""
+    return {}
+
+
 class Metric(ABC):
     """Base class for all metrics.
 
@@ -294,7 +301,7 @@ class Metric(ABC):
     jit_compute_default: bool = True
 
     def __init__(self, **kwargs: Any) -> None:
-        object.__setattr__(self, "_state", {})
+        object.__setattr__(self, "_state", _make_state_dict(self))
         self._defaults: Dict[str, Any] = {}
         self._reduce_fns: Dict[str, Any] = {}
         self._persistent: Dict[str, bool] = {}
@@ -774,7 +781,9 @@ class Metric(ABC):
         """Run an imperative method body against a swapped-in state pytree."""
         old = self.__dict__["_state"]
         old_swapped = self._state_swapped
-        object.__setattr__(self, "_state", dict(state))
+        scratch = _make_state_dict(self)
+        scratch.update(state)
+        object.__setattr__(self, "_state", scratch)
         object.__setattr__(self, "_state_swapped", True)
         try:
             out = fn(*args, **kwargs)

@@ -16,6 +16,7 @@ Array = jax.Array
 
 
 class ExplainedVariance(Metric):
+    stackable = True  # scalar sum states only; per-stream stacking is exact
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

@@ -24,6 +24,8 @@ class MeanAbsoluteError(Metric):
         >>> float(metric.compute())
         0.5
     """
+
+    stackable = True  # scalar sum states only; per-stream stacking is exact
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

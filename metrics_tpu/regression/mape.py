@@ -15,6 +15,7 @@ Array = jax.Array
 
 
 class MeanAbsolutePercentageError(Metric):
+    stackable = True  # scalar sum states only; per-stream stacking is exact
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -24,6 +24,8 @@ class MeanSquaredError(Metric):
         >>> float(metric.compute())
         0.875
     """
+
+    stackable = True  # scalar sum states only; per-stream stacking is exact
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

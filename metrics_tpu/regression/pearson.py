@@ -57,6 +57,8 @@ class PearsonCorrCoef(Metric):
         >>> round(float(metric.compute()), 6)
         0.98487
     """
+
+    stackable = True  # fixed-shape Welford accumulators; streams stack independently
     is_differentiable = True
     higher_is_better = None
     full_state_update = True

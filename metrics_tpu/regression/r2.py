@@ -24,6 +24,8 @@ class R2Score(Metric):
         >>> round(float(metric.compute()), 6)
         0.948608
     """
+
+    stackable = True  # fixed (num_outputs,) sum states; per-stream stacking is exact
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

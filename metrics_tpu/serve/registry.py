@@ -97,6 +97,10 @@ class EvalJob:
         # RLock: a checkpoint encode under the registry-wide lock sweep may
         # re-enter through metric hooks that take the same job's lock
         self.lock = threading.RLock()
+        try:  # named in the runtime lock-witness graph; raw RLocks reject attrs
+            self.lock.witness_name = f"EvalJob[{name}].lock"
+        except AttributeError:
+            pass
         self.records_ingested = 0  # host counter, consumer thread only
         self.blocks_dispatched = 0
 

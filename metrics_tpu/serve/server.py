@@ -95,6 +95,10 @@ class EvalServer:
         self._stopped = False
         self._t0 = time.monotonic()
         self._ckpt_lock = threading.Lock()  # serializes checkpoint_now callers
+        try:  # named in the runtime lock-witness graph; raw Locks reject attrs
+            self._ckpt_lock.witness_name = "EvalServer._ckpt_lock"
+        except AttributeError:
+            pass
 
     # ---------------------------------------------------------------- startup
     def start(self) -> "EvalServer":

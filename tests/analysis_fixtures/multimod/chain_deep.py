@@ -1,0 +1,14 @@
+# lock-order transitive positive, module 3/3: the blocking primitive.
+# work_q.put() is untimed — a dead consumer never drains it, so whoever
+# reaches this while holding a lock parks every other waiter with it.
+import queue
+
+work_q = queue.Queue()
+
+
+def blocker():
+    work_q.put(object())
+
+
+def step_two():
+    return blocker()

@@ -1,0 +1,15 @@
+# lock-order transitive positive, module 1/3: the lock holder. The
+# blocking call is three edges away (entry -> step_one -> step_two ->
+# block_now) and two modules removed — only the call-graph closure sees it.
+import threading
+
+from metrics_tpu.chain_mid import step_one
+
+
+class Coordinator:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def entry(self):
+        with self.lock:
+            return step_one()  # blocking-callee-under-lock via the chain

@@ -1,0 +1,8 @@
+# lock-order transitive positive, module 2/3: a pure relay with no lock
+# vocabulary anywhere — the pass's `applies` prefilter skips it, so it is
+# only ever scanned lazily through the call graph.
+from metrics_tpu.chain_deep import step_two
+
+
+def step_one():
+    return step_two()

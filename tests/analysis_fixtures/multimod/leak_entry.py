@@ -1,0 +1,11 @@
+# trace-safety cross-module positive, module 1/2: the traced region. The
+# jitted entry calls a helper that lives in another module; the host call
+# inside it is invisible to any single-module scan.
+import jax
+
+from metrics_tpu.leak_helper import massage
+
+
+@jax.jit
+def traced_entry(x):
+    return massage(x) * 2.0

@@ -1,0 +1,8 @@
+# trace-safety cross-module positive, module 2/2: an innocent-looking
+# helper with a host numpy call. Fine eagerly; a constant-burning silent
+# de-optimization once it is reached from a traced region.
+import numpy as np
+
+
+def massage(x):
+    return np.asarray(x)

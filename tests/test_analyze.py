@@ -72,8 +72,13 @@ def test_registry_has_all_passes():
         "trace-safety",
         "lock-order",
         "state-contract",
+        "lock-witness",
+        "state-race",
     }
-    assert len(PASSES) >= 7
+    assert len(PASSES) >= 9
+    # the runtime sanitizer passes are dynamic: they drive the live burst
+    assert PASSES["lock-witness"].kind == "dynamic"
+    assert PASSES["state-race"].kind == "dynamic"
 
 
 @pytest.mark.parametrize("name", sorted(PASSES))

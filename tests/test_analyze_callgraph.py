@@ -1,0 +1,304 @@
+"""Call-graph substrate + cross-module fixtures + incremental mode.
+
+Three layers, matching the analysis stack:
+
+* unit tests for ``tools/analyze/callgraph.py`` itself — edge resolution
+  through import aliases, self-methods, constructor-typed attributes;
+  depth-bounded shortest chains; reverse module-dependency closure;
+* multi-module fixtures through :func:`analyze_sources`, with regression
+  pins proving the pre-call-graph behavior (one-callee propagation,
+  single-module scans) would MISS them;
+* ``--changed`` incremental runs over a scratch package: cold seed, warm
+  hit, dirty + dependents re-analysis.
+"""
+
+import textwrap
+
+from tools.analyze import analyze_source, analyze_sources, PASSES
+from tools.analyze.callgraph import build_call_graph
+from tools.analyze.engine import ModuleUnit
+from tools.analyze.incremental import run_changed
+
+from pathlib import Path
+
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures" / "multimod"
+
+
+def _units(sources):
+    return [ModuleUnit(rel, textwrap.dedent(src)) for rel, src in sorted(sources.items())]
+
+
+def _read(*names):
+    return {
+        f"metrics_tpu/{name}": (FIXTURES / name).read_text() for name in names
+    }
+
+
+# ---------------------------------------------------------------------------
+# graph construction
+# ---------------------------------------------------------------------------
+
+
+def test_edges_resolve_through_import_aliases():
+    graph = build_call_graph(_units({
+        "metrics_tpu/a.py": """
+            from metrics_tpu.b import helper as h
+
+            def caller():
+                return h()
+        """,
+        "metrics_tpu/b.py": """
+            def helper():
+                return 1
+        """,
+    }))
+    edges = graph.out["metrics_tpu/a.py::caller"]
+    assert [e.callee for e in edges] == ["metrics_tpu/b.py::helper"]
+
+
+def test_self_method_and_attr_constructor_receivers():
+    graph = build_call_graph(_units({
+        "metrics_tpu/svc.py": """
+            from metrics_tpu.dep import Worker
+
+            class Service:
+                def __init__(self):
+                    self.worker = Worker()
+
+                def run(self):
+                    self.step()
+                    self.worker.spin()
+
+                def step(self):
+                    pass
+        """,
+        "metrics_tpu/dep.py": """
+            class Worker:
+                def spin(self):
+                    pass
+        """,
+    }))
+    callees = {e.callee for e in graph.out["metrics_tpu/svc.py::Service.run"]}
+    assert callees == {
+        "metrics_tpu/svc.py::Service.step",
+        "metrics_tpu/dep.py::Worker.spin",
+    }
+
+
+def test_method_resolution_walks_bases():
+    graph = build_call_graph(_units({
+        "metrics_tpu/base.py": """
+            class Base:
+                def tick(self):
+                    pass
+        """,
+        "metrics_tpu/sub.py": """
+            from metrics_tpu.base import Base
+
+            class Sub(Base):
+                def go(self):
+                    self.tick()
+        """,
+    }))
+    callees = [e.callee for e in graph.out["metrics_tpu/sub.py::Sub.go"]]
+    assert callees == ["metrics_tpu/base.py::Base.tick"]
+
+
+def test_chains_shortest_path_and_depth_bound():
+    graph = build_call_graph(_units({
+        "metrics_tpu/m.py": """
+            def a():
+                b()
+
+            def b():
+                c()
+
+            def c():
+                d()
+
+            def d():
+                pass
+        """,
+    }))
+    start = [("metrics_tpu/m.py::a", 0)]
+    deep = graph.chains(start, depth=3)
+    assert "metrics_tpu/m.py::d" in deep
+    assert [fid for fid, _ in deep["metrics_tpu/m.py::d"]] == [
+        "metrics_tpu/m.py::a",
+        "metrics_tpu/m.py::b",
+        "metrics_tpu/m.py::c",
+        "metrics_tpu/m.py::d",
+    ]
+    shallow = graph.chains(start, depth=1)
+    assert "metrics_tpu/m.py::d" not in shallow  # the bound prunes it
+    assert "metrics_tpu/m.py::b" in shallow
+
+
+def test_dependents_reverse_closure():
+    graph = build_call_graph(_units({
+        "metrics_tpu/leaf.py": """
+            def f():
+                pass
+        """,
+        "metrics_tpu/mid.py": """
+            from metrics_tpu.leaf import f
+
+            def g():
+                f()
+        """,
+        "metrics_tpu/top.py": """
+            from metrics_tpu.mid import g
+
+            def h():
+                g()
+        """,
+        "metrics_tpu/unrelated.py": """
+            def lonely():
+                pass
+        """,
+    }))
+    deps = graph.dependents(["metrics_tpu/leaf.py"])
+    assert deps == {"metrics_tpu/mid.py", "metrics_tpu/top.py"}
+    assert graph.dependents(["metrics_tpu/unrelated.py"]) == set()
+
+
+# ---------------------------------------------------------------------------
+# multi-module fixtures: exact counts + full chain provenance
+# ---------------------------------------------------------------------------
+
+
+def test_transitive_lock_chain_is_found_with_provenance():
+    findings = analyze_sources(
+        "lock-order", _read("chain_entry.py", "chain_mid.py", "chain_deep.py")
+    )
+    rendered = "\n".join(f.render() for f in findings)
+    assert len(findings) == 1, rendered
+    f = findings[0]
+    assert f.rule == "blocking-callee-under-lock"
+    assert f.module == "metrics_tpu/chain_entry.py"
+    # the detail carries the full call chain — that IS the provenance, and
+    # it keys the baseline, so chains are stable identities
+    assert f.detail == "Coordinator.entry:step_one->step_two->blocker"
+    assert "step_one -> step_two -> blocker" in f.message
+
+
+def test_depth_one_closure_would_miss_the_chain():
+    # regression pin: the pre-call-graph pass propagated blocking exactly
+    # one callee deep; this chain needs three hops
+    p = PASSES["lock-order"]
+    saved = p.depth
+    p.depth = 1
+    try:
+        findings = analyze_sources(
+            "lock-order", _read("chain_entry.py", "chain_mid.py", "chain_deep.py")
+        )
+    finally:
+        p.depth = saved
+    assert findings == []
+
+
+def test_cross_module_trace_leak_is_found_with_via_chain():
+    findings = analyze_sources(
+        "trace-safety", _read("leak_entry.py", "leak_helper.py")
+    )
+    rendered = "\n".join(f.render() for f in findings)
+    assert len(findings) == 1, rendered
+    f = findings[0]
+    assert f.rule == "numpy-in-trace"
+    assert f.module == "metrics_tpu/leak_helper.py"  # flagged where it lives
+    assert "traced via traced_entry -> massage" in f.message
+
+
+def test_single_module_scan_would_miss_the_leak():
+    # regression pin: either module alone is clean — the leak only exists
+    # across the import edge, which is what the call graph adds
+    sources = _read("leak_entry.py", "leak_helper.py")
+    for rel, src in sources.items():
+        assert analyze_source("trace-safety", src, rel=rel) == []
+
+
+# ---------------------------------------------------------------------------
+# incremental (--changed) mode
+# ---------------------------------------------------------------------------
+
+_PKG = {
+    "leaf.py": """
+        def f():
+            pass
+    """,
+    "top.py": """
+        from metrics_tpu.leaf import f
+
+        def h():
+            f()
+    """,
+}
+
+
+def _plant(tmp_path):
+    pkg = tmp_path / "metrics_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name, src in _PKG.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def test_incremental_cold_then_warm_then_dirty(tmp_path):
+    root = _plant(tmp_path)
+    cache = tmp_path / "cache.json"
+
+    report, info = run_changed(root=str(root), cache_path=str(cache),
+                               baseline_path=None)
+    assert not info["warm"] and report.ok
+    assert info["analyzed"] == 3  # cold: everything
+
+    report, info = run_changed(root=str(root), cache_path=str(cache),
+                               baseline_path=None)
+    assert info["warm"] and info["analyzed"] == 0 and report.ok
+
+    # dirty leaf.py: its dependent top.py must ride along
+    (root / "metrics_tpu" / "leaf.py").write_text(
+        "import time\n\n\ndef f():\n    time.sleep(0.1)\n"
+    )
+    report, info = run_changed(root=str(root), cache_path=str(cache),
+                               baseline_path=None)
+    assert not info["warm"]
+    assert info["dirty"] == ["metrics_tpu/leaf.py"]
+    assert info["analyzed"] == 2 and info["dependents"] == 1
+
+
+def test_incremental_finds_planted_finding_and_clears_it(tmp_path):
+    root = _plant(tmp_path)
+    cache = tmp_path / "cache.json"
+    run_changed(root=str(root), cache_path=str(cache), baseline_path=None)
+
+    # plant a direct blocking-under-lock in a fresh module
+    bad = root / "metrics_tpu" / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+        import queue
+
+        q = queue.Queue()
+        mu_lock = threading.Lock()
+
+
+        def stall():
+            with mu_lock:
+                q.get()
+    """))
+    report, info = run_changed(root=str(root), cache_path=str(cache),
+                               baseline_path=None)
+    assert info["dirty"] == ["metrics_tpu/bad.py"]
+    assert [f.rule for f in report.findings] == ["blocking-under-lock"]
+
+    # a warm re-run reports the same finding from cache (no re-analysis)
+    report, info = run_changed(root=str(root), cache_path=str(cache),
+                               baseline_path=None)
+    assert info["warm"]
+    assert [f.rule for f in report.findings] == ["blocking-under-lock"]
+
+    bad.unlink()
+    report, info = run_changed(root=str(root), cache_path=str(cache),
+                               baseline_path=None)
+    assert report.ok  # deleted module's cached findings are dropped

@@ -1,0 +1,195 @@
+"""Runtime concurrency sanitizer: planted-fixture and green-path tests.
+
+The static passes have source fixtures; the dynamic passes (lock-witness,
+state-race) get *planted concurrency bugs*: a real ABBA cycle, a real
+unlocked cross-thread state write, a real park-while-held.  Each scenario
+runs under the live witness instrumentation (``witnessed_run``) and must
+produce exactly the expected finding — so "the sanitizer can see it" is a
+tested property.  The clean-repo green run rides in
+``tests/test_analyze.py::test_repo_is_clean_under_every_pass``, which
+drives the full serve burst through both passes.
+"""
+
+import threading
+
+from tools.analyze import PASSES
+from tools.analyze.runtime.sanitizer import witnessed_run
+from tools.analyze.runtime.witness import WitnessLog, witness_session
+
+
+def _lock_findings(log):
+    return [(f.rule, f.detail) for f in PASSES["lock-witness"].findings_from_log(log)]
+
+
+def _race_findings(log):
+    return [(f.rule, f.detail) for f in PASSES["state-race"].findings_from_log(log)]
+
+
+# ---------------------------------------------------------------------------
+# planted scenarios: each must be caught, with a stable fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_witness_catches_abba_cycle():
+    def workload():
+        from metrics_tpu.regression import MeanSquaredError
+        from metrics_tpu.serve.registry import EvalJob
+
+        a = EvalJob("a", MeanSquaredError())
+        b = EvalJob("b", MeanSquaredError())
+
+        def ab():
+            with a.lock:
+                with b.lock:
+                    pass
+
+        def ba():
+            with b.lock:
+                with a.lock:
+                    pass
+
+        # sequential on purpose: the witness flags the *order* violation
+        # without needing the schedule to actually interleave into deadlock
+        t1 = threading.Thread(target=ab)
+        t2 = threading.Thread(target=ba)
+        t1.start(); t1.join()
+        t2.start(); t2.join()
+
+    log = witnessed_run(workload)
+    cycles = [d for r, d in _lock_findings(log) if r == "runtime-lock-cycle"]
+    assert cycles == ["EvalJob[a].lock<->EvalJob[b].lock"], cycles
+
+
+def test_witness_catches_unlocked_cross_thread_state_write():
+    def workload():
+        from metrics_tpu.regression import MeanSquaredError
+
+        m = MeanSquaredError()
+
+        def hammer(val):
+            for i in range(50):
+                m._state["sum_squared_error"] = float(val + i)
+
+        threads = [threading.Thread(target=hammer, args=(k,)) for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    log = witnessed_run(workload)
+    races = [d for r, d in _race_findings(log) if r == "unlocked-state-write"]
+    assert races == ["MeanSquaredError.sum_squared_error"], races
+
+
+def test_witness_catches_blocking_while_held():
+    def workload():
+        from metrics_tpu.regression import MeanSquaredError
+        from metrics_tpu.serve.registry import EvalJob
+
+        slow = EvalJob("slow", MeanSquaredError())
+        fast = EvalJob("fast", MeanSquaredError())
+        ready = threading.Event()
+
+        def sleeper():
+            with slow.lock:
+                ready.set()
+                import time
+
+                time.sleep(0.6)
+
+        t = threading.Thread(target=sleeper)
+        t.start()
+        ready.wait(timeout=5.0)
+        with fast.lock:  # park on slow's lock while holding fast's
+            with slow.lock:
+                pass
+        t.join()
+
+    log = witnessed_run(workload, block_threshold=0.25)
+    parked = [d for r, d in _lock_findings(log) if r == "runtime-blocking-while-held"]
+    assert parked == ["EvalJob[slow].lock:EvalJob[fast].lock"], parked
+
+
+# ---------------------------------------------------------------------------
+# the witness must not flag healthy patterns
+# ---------------------------------------------------------------------------
+
+
+def test_witness_accepts_consistent_order_and_locked_writes():
+    def workload():
+        from metrics_tpu.regression import MeanSquaredError
+        from metrics_tpu.serve.registry import EvalJob
+
+        job = EvalJob("ok", MeanSquaredError())
+
+        def writer(val):
+            for i in range(20):
+                with job.lock:  # the lock the reader uses too: no race
+                    job.metric._state["sum_squared_error"] = float(val + i)
+
+        threads = [threading.Thread(target=writer, args=(k,)) for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    log = witnessed_run(workload)
+    assert [d for r, d in _lock_findings(log) if r != "witness-no-coverage"] == []
+    assert _race_findings(log) == []
+
+
+def test_exclusive_init_phase_is_not_a_race():
+    # the Eraser state machine: single-thread init writes without the lock
+    # are the normal constructor pattern, not a race
+    def workload():
+        from metrics_tpu.regression import MeanSquaredError
+
+        m = MeanSquaredError()
+        for i in range(10):
+            m._state["sum_squared_error"] = float(i)  # main thread only
+
+    log = witnessed_run(workload)
+    assert _race_findings(log) == []
+
+
+# ---------------------------------------------------------------------------
+# coverage sentinels: a rotted driver turns red, not vacuously green
+# ---------------------------------------------------------------------------
+
+
+def test_no_coverage_turns_the_pass_red():
+    with witness_session() as log:
+        pass  # no workload: no locks created, no state written
+    assert ("witness-no-coverage", "locks") in _lock_findings(log)
+    assert ("witness-no-coverage", "state") in _race_findings(log)
+
+
+def test_witness_session_restores_patches():
+    before = (threading.Lock, threading.RLock)
+    with witness_session():
+        assert (threading.Lock, threading.RLock) != before
+    assert (threading.Lock, threading.RLock) == before
+
+
+def test_witnessed_lock_duck_types_for_condition():
+    # Condition binds _release_save/_acquire_restore by attribute probe:
+    # the proxy must expose them exactly when the inner lock does
+    def workload():
+        from metrics_tpu.serve.registry import EvalJob
+        from metrics_tpu.regression import MeanSquaredError
+
+        job = EvalJob("cond", MeanSquaredError())
+        cond = threading.Condition(job.lock)  # RLock proxy: has the hooks
+        with cond:
+            cond.notify_all()
+
+    log = witnessed_run(workload)
+    assert [d for r, d in _lock_findings(log) if r != "witness-no-coverage"] == []
+
+
+def test_state_write_log_has_sites():
+    log = WitnessLog()
+    log.on_state_write(1, "Demo", "total")
+    ((serial, otype, key), rec), = log.state_writes.items()
+    assert (serial, otype, key) == (1, "Demo", "total")
+    assert rec["writes"] == 1 and rec["lockset"] is None  # exclusive phase

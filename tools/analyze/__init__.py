@@ -15,6 +15,7 @@ from tools.analyze.engine import (  # noqa: F401
     ModuleUnit,
     Report,
     analyze_source,
+    analyze_sources,
     discover_units,
     load_baseline,
     register_pass,
@@ -31,6 +32,7 @@ __all__ = [
     "ModuleUnit",
     "Report",
     "analyze_source",
+    "analyze_sources",
     "discover_units",
     "load_baseline",
     "register_pass",

@@ -3,13 +3,18 @@
     python -m tools.analyze                 # run every pass, human output
     python -m tools.analyze --json          # machine-readable report
     python -m tools.analyze --pass lock-order --pass trace-safety
+    python -m tools.analyze --changed       # incremental pre-commit gate
     python -m tools.analyze --list-passes
     python -m tools.analyze --update-baseline
 
 Exit status: 0 when every finding is baselined (or none), 1 when any fresh
 finding exists, 2 on usage errors.  ``--update-baseline`` rewrites
 ``tools/analyze/baseline.json`` from the current findings (preserving
-existing justifications) and exits 0.
+existing justifications, reporting fingerprints that disappeared on
+stderr) and exits 0.  ``--changed`` re-analyzes only modules that changed
+plus their call-graph dependents (static passes only — see
+``tools/analyze/incremental.py``); a warm no-change run finishes in well
+under two seconds, which is what makes it usable as a pre-commit hook.
 """
 
 from __future__ import annotations
@@ -43,6 +48,14 @@ def main(argv=None) -> int:
         help="rewrite baseline.json from the current findings and exit 0",
     )
     parser.add_argument(
+        "--changed",
+        action="store_true",
+        help=(
+            "incremental mode: analyze only changed modules plus their "
+            "call-graph dependents (static passes only)"
+        ),
+    )
+    parser.add_argument(
         "--show-baselined",
         action="store_true",
         help="also print findings absorbed by the baseline",
@@ -58,6 +71,39 @@ def main(argv=None) -> int:
             print(f"{name:22s} [{p.kind}] {p.description}")
         return 0
 
+    if args.changed:
+        if args.update_baseline or args.passes:
+            print(
+                "--changed runs the full static pass set and never rewrites "
+                "the baseline; drop --pass/--update-baseline",
+                file=sys.stderr,
+            )
+            return 2
+        from tools.analyze import incremental
+
+        report, info = incremental.run_changed(root=args.root)
+        if args.json:
+            payload = report.to_json()
+            payload["changed"] = info
+            print(json.dumps(payload, indent=2, sort_keys=True))
+            return 0 if report.ok else 1
+        for f in report.findings:
+            print(f.render())
+        if args.show_baselined:
+            for f in report.baselined:
+                print(f"(baselined) {f.render()}")
+        mode = (
+            "cache warm, nothing changed"
+            if info["warm"]
+            else f"{len(info['dirty'])} dirty + {info['dependents']} dependent(s)"
+        )
+        print(
+            f"{len(report.findings)} finding(s), {len(report.baselined)} "
+            f"baselined, {report.modules_analyzed} module(s) re-analyzed "
+            f"({mode}; static passes only)"
+        )
+        return 0 if report.ok else 1
+
     try:
         report = engine.run_passes(
             pass_names=args.passes,
@@ -69,7 +115,12 @@ def main(argv=None) -> int:
         return 2
 
     if args.update_baseline:
+        previous = set(engine.load_baseline())
         entries = engine.update_baseline(report.findings)
+        # fingerprints that disappeared are *fixed* findings — surface them
+        # so the fix gets celebrated (and the justification text retired)
+        for key in sorted(previous - set(entries)):
+            print(f"fixed (removed from baseline): {key}", file=sys.stderr)
         print(
             f"baseline rewritten: {len(entries)} fingerprint(s) covering "
             f"{len(report.findings)} finding(s)"

@@ -1,0 +1,457 @@
+"""Whole-package call graph: the interprocedural substrate for the passes.
+
+One graph per engine run (cached in ``AnalysisContext.scratch``), built from
+the same :class:`~tools.analyze.engine.ModuleUnit` table every pass sees.
+Nodes are functions keyed ``<module rel>::<qualname>``; edges are resolved
+call sites carrying their source line, so a pass can print the full chain
+behind a transitive finding (``EvalServer.flush -> IngestQueue.put_control``)
+instead of a bare call-site location.
+
+Edge resolution is deliberately conservative — an edge exists only when the
+static evidence names a concrete target:
+
+* **local calls** — ``helper(...)`` binds to same-module functions with that
+  simple name, or (through the import table) to a function in another
+  package module (``from metrics_tpu.serve.ingest import chunks``).
+* **self/cls methods** — ``self.flush()`` binds to the enclosing class's
+  method, walking resolved base classes across modules when the class does
+  not define it.
+* **typed receivers** — ``self.queue.put_control()`` binds through the
+  class's attribute-type table: an attribute assigned a constructor call in
+  any method (``self.queue = IngestQueue(...)``) carries that class's type.
+  Local names get the same treatment (``mgr = CheckpointManager(...)``).
+* **class-qualified calls** — ``IngestQueue.put_control(q, ...)`` binds
+  through the import table to the named class's method.
+
+Receiver typing stops at constructor assignments on purpose: propagating
+parameter annotations or return types would flood the graph with
+possible-but-unproven edges, and every downstream pass treats an edge as
+"this call CAN happen" when deciding to report.
+
+Reachability helpers (:meth:`CallGraph.chains`) are depth-bounded BFS that
+return the shortest provenance chain per reached node — the passes bound
+their closure (``depth`` attribute on each migrated pass) so a pathological
+fixture cannot make analysis super-linear.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.engine import AnalysisContext, ModuleUnit, dotted_name
+
+_SCRATCH_KEY = "callgraph"
+
+PACKAGE_PREFIX = "metrics_tpu."
+
+
+@dataclasses.dataclass
+class FuncNode:
+    """One function/method/lambda in the package."""
+
+    fid: str  # "<module rel>::<qualname>"
+    rel: str
+    qualname: str
+    cls: Optional[str]  # enclosing class qualname (module-local) or None
+    node: ast.AST
+    lineno: int
+
+    @property
+    def simple(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class CallEdge:
+    """One resolved call site: ``caller`` invokes ``callee`` at ``lineno``."""
+
+    caller: str
+    callee: str
+    lineno: int
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """One class: its methods by simple name, resolved bases, attr types."""
+
+    dotted: str  # "<module dotted>.<class qualname>"
+    rel: str
+    methods: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    bases: List[str] = dataclasses.field(default_factory=list)  # resolved dotted
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def body_nodes(fn: ast.AST):
+    """Walk a function's own body without descending into nested defs."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def collect_functions(tree: ast.Module, rel: str) -> Tuple[List[FuncNode], List[Tuple[str, ast.ClassDef]]]:
+    """All functions (incl. lambdas) plus the class definitions of a module."""
+    funcs: List[FuncNode] = []
+    classes: List[Tuple[str, ast.ClassDef]] = []
+
+    def visit(node: ast.AST, scope: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{scope}.{child.name}" if scope else child.name
+                funcs.append(FuncNode(f"{rel}::{qual}", rel, qual, cls, child, child.lineno))
+                visit(child, qual, None)
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{scope}.{child.name}" if scope else child.name
+                classes.append((qual, child))
+                visit(child, qual, qual)
+            elif isinstance(child, ast.Lambda):
+                qual = (
+                    f"{scope}.<lambda@{child.lineno}>" if scope else f"<lambda@{child.lineno}>"
+                )
+                funcs.append(FuncNode(f"{rel}::{qual}", rel, qual, cls, child, child.lineno))
+                visit(child, qual, None)
+            else:
+                visit(child, scope, cls)
+
+    visit(tree, "", None)
+    return funcs, classes
+
+
+class CallGraph:
+    """The package-wide graph plus the lookup tables edge resolution used."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, FuncNode] = {}
+        self.out: Dict[str, List[CallEdge]] = {}
+        self.classes: Dict[str, ClassInfo] = {}  # dotted class -> info
+        self._by_module_simple: Dict[Tuple[str, str], List[str]] = {}
+        self._func_by_dotted: Dict[str, List[str]] = {}
+        self._class_by_rel_qual: Dict[Tuple[str, str], ClassInfo] = {}
+        # module dependency edges (rel -> rels it statically calls/imports into)
+        self.module_deps: Dict[str, Set[str]] = {}
+
+    # ------------------------------------------------------------- lookups
+    def node(self, fid: str) -> Optional[FuncNode]:
+        return self.funcs.get(fid)
+
+    def local_candidates(self, rel: str, simple: str) -> List[str]:
+        return self._by_module_simple.get((rel, simple), [])
+
+    def function_by_dotted(self, dotted: str) -> List[str]:
+        return self._func_by_dotted.get(dotted, [])
+
+    def class_info(self, dotted: str) -> Optional[ClassInfo]:
+        return self.classes.get(dotted)
+
+    def resolve_method(
+        self, class_dotted: str, simple: str, _seen: Optional[Set[str]] = None
+    ) -> List[str]:
+        """Method lookup on a class, walking resolved bases (C3-ish, first hit)."""
+        seen = _seen if _seen is not None else set()
+        if class_dotted in seen:
+            return []
+        seen.add(class_dotted)
+        info = self.classes.get(class_dotted)
+        if info is None:
+            return []
+        hit = info.methods.get(simple)
+        if hit:
+            return hit
+        for base in info.bases:
+            found = self.resolve_method(base, simple, seen)
+            if found:
+                return found
+        return []
+
+    def display(self, fid: str) -> str:
+        """Human chain segment: the qualname (module added only on clashes)."""
+        node = self.funcs.get(fid)
+        return node.qualname if node is not None else fid
+
+    # -------------------------------------------------------- reachability
+    def chains(
+        self,
+        starts: Sequence[Tuple[str, int]],
+        depth: int,
+        stop: Optional[Callable[[FuncNode], bool]] = None,
+    ) -> Dict[str, List[Tuple[str, int]]]:
+        """Depth-bounded BFS from ``starts`` (``(fid, call lineno)`` pairs).
+
+        Returns ``{reached fid: [(fid, lineno), ...]}`` — the shortest chain
+        of call sites from a start to that node, starts included.  ``stop``
+        prunes expansion below matching nodes (their chain is still
+        recorded), which is how a pass stops at the first blocking callee.
+        """
+        reached: Dict[str, List[Tuple[str, int]]] = {}
+        frontier: List[Tuple[str, List[Tuple[str, int]]]] = []
+        for fid, lineno in starts:
+            if fid in self.funcs and fid not in reached:
+                chain = [(fid, lineno)]
+                reached[fid] = chain
+                frontier.append((fid, chain))
+        for _ in range(max(0, depth)):
+            if not frontier:
+                break
+            nxt: List[Tuple[str, List[Tuple[str, int]]]] = []
+            for fid, chain in frontier:
+                node = self.funcs[fid]
+                if stop is not None and stop(node):
+                    continue
+                for edge in self.out.get(fid, ()):
+                    if edge.callee in reached:
+                        continue
+                    sub = chain + [(edge.callee, edge.lineno)]
+                    reached[edge.callee] = sub
+                    nxt.append((edge.callee, sub))
+            frontier = nxt
+        return reached
+
+    def render_chain(self, chain: Sequence[Tuple[str, int]]) -> str:
+        return " -> ".join(self.display(fid) for fid, _ in chain)
+
+    # ---------------------------------------------------------- dependents
+    def dependents(self, rels: Iterable[str]) -> Set[str]:
+        """Transitive reverse module-dependency closure of ``rels``."""
+        reverse: Dict[str, Set[str]] = {}
+        for src, dsts in self.module_deps.items():
+            for dst in dsts:
+                reverse.setdefault(dst, set()).add(src)
+        out: Set[str] = set()
+        frontier = [r for r in rels]
+        while frontier:
+            rel = frontier.pop()
+            for dep in reverse.get(rel, ()):
+                if dep not in out:
+                    out.add(dep)
+                    frontier.append(dep)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# construction
+
+
+def _resolve_class_ref(unit: ModuleUnit, expr: ast.AST, local_classes: Set[str]) -> Optional[str]:
+    """A Name/Attribute that names a class -> its dotted package path."""
+    dotted = dotted_name(expr)
+    if dotted is None:
+        return None
+    if dotted in local_classes:
+        return f"{unit.dotted}.{dotted}"
+    resolved = unit.resolve(expr)
+    if resolved and resolved.startswith(PACKAGE_PREFIX):
+        return resolved
+    return None
+
+
+def _constructor_class(
+    unit: ModuleUnit, value: ast.AST, local_classes: Set[str]
+) -> Optional[str]:
+    """``IngestQueue(...)`` -> the constructed class's dotted path, or None."""
+    if not isinstance(value, ast.Call):
+        return None
+    return _resolve_class_ref(unit, value.func, local_classes)
+
+
+def build_call_graph(units: Sequence[ModuleUnit]) -> CallGraph:
+    graph = CallGraph()
+    per_unit: List[Tuple[ModuleUnit, List[FuncNode], List[Tuple[str, ast.ClassDef]]]] = []
+    dotted_to_rel: Dict[str, str] = {}
+
+    # pass 1: nodes, class tables, module index
+    for unit in units:
+        tree = unit.tree
+        if tree is None:
+            continue
+        dotted_to_rel[unit.dotted] = unit.rel
+        funcs, classes = collect_functions(tree, unit.rel)
+        per_unit.append((unit, funcs, classes))
+        for f in funcs:
+            graph.funcs[f.fid] = f
+            graph._by_module_simple.setdefault((unit.rel, f.simple), []).append(f.fid)
+            graph._func_by_dotted.setdefault(f"{unit.dotted}.{f.qualname}", []).append(f.fid)
+        local_class_names = {qual for qual, _ in classes}
+        for qual, cls_node in classes:
+            info = ClassInfo(dotted=f"{unit.dotted}.{qual}", rel=unit.rel)
+            for f in funcs:
+                if f.cls == qual:
+                    info.methods.setdefault(f.simple, []).append(f.fid)
+            for base in cls_node.bases:
+                resolved = _resolve_class_ref(unit, base, local_class_names)
+                if resolved:
+                    info.bases.append(resolved)
+            graph.classes[info.dotted] = info
+            graph._class_by_rel_qual[(unit.rel, qual)] = info
+
+    # pass 2: attribute types (self.x = Class(...)) — needs the class table
+    for unit, funcs, classes in per_unit:
+        local_class_names = {qual for qual, _ in classes}
+        for f in funcs:
+            if f.cls is None:
+                continue
+            info = graph._class_by_rel_qual.get((unit.rel, f.cls))
+            if info is None:
+                continue
+            for node in body_nodes(f.node):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                if value is None:
+                    continue
+                ctor = _constructor_class(unit, value, local_class_names)
+                if ctor is None:
+                    continue
+                for target in targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id in ("self", "cls")
+                    ):
+                        info.attr_types.setdefault(target.attr, ctor)
+
+    # pass 3: edges
+    for unit, funcs, classes in per_unit:
+        local_class_names = {qual for qual, _ in classes}
+        deps = graph.module_deps.setdefault(unit.rel, set())
+        # package-internal imports are module dependencies even without a
+        # resolved call edge (constants, class references, decorators)
+        for alias_target in unit.imports.values():
+            if not alias_target.startswith(PACKAGE_PREFIX) and alias_target != "metrics_tpu":
+                continue
+            probe = alias_target
+            while probe and probe not in dotted_to_rel:
+                probe = probe.rpartition(".")[0]
+            if probe:
+                deps.add(dotted_to_rel[probe])
+        for f in funcs:
+            # local variable constructor types within this function
+            local_types: Dict[str, str] = {}
+            for node in body_nodes(f.node):
+                targets = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                if value is None:
+                    continue
+                ctor = _constructor_class(unit, value, local_class_names)
+                if ctor is None:
+                    continue
+                for target in targets:
+                    if isinstance(target, ast.Name):
+                        local_types.setdefault(target.id, ctor)
+            seen_edges: Set[Tuple[str, int]] = set()
+            for node in body_nodes(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in _resolve_call(graph, unit, f, node, local_types, local_class_names):
+                    key = (callee, node.lineno)
+                    if key in seen_edges:
+                        continue
+                    seen_edges.add(key)
+                    graph.out.setdefault(f.fid, []).append(
+                        CallEdge(caller=f.fid, callee=callee, lineno=node.lineno)
+                    )
+                    target_rel = graph.funcs[callee].rel
+                    if target_rel != unit.rel:
+                        deps.add(target_rel)
+    return graph
+
+
+def _resolve_call(
+    graph: CallGraph,
+    unit: ModuleUnit,
+    caller: FuncNode,
+    call: ast.Call,
+    local_types: Dict[str, str],
+    local_classes: Set[str],
+) -> List[str]:
+    fn = call.func
+    # helper(...) — same-module functions by simple name, else imported function
+    if isinstance(fn, ast.Name):
+        local = graph.local_candidates(unit.rel, fn.id)
+        if local:
+            # prefer module-level / same-class functions; fall back to all
+            preferred = [
+                fid
+                for fid in local
+                if graph.funcs[fid].cls is None or graph.funcs[fid].cls == caller.cls
+            ]
+            return preferred or local
+        resolved = unit.imports.get(fn.id)
+        if resolved and resolved.startswith(PACKAGE_PREFIX):
+            return graph.function_by_dotted(resolved)
+        return []
+    if not isinstance(fn, ast.Attribute):
+        return []
+    recv = fn.value
+    # self.method(...) / cls.method(...): enclosing class, bases included
+    if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+        if caller.cls is not None:
+            info = graph._class_by_rel_qual.get((unit.rel, caller.cls))
+            if info is not None:
+                hit = graph.resolve_method(info.dotted, fn.attr)
+                if hit:
+                    return hit
+        return []
+    # x.method(...) with a constructor-typed local
+    if isinstance(recv, ast.Name):
+        ctor = local_types.get(recv.id)
+        if ctor:
+            return graph.resolve_method(ctor, fn.attr)
+        # ClassName.method(...) / imported-module function
+        resolved = unit.resolve(recv)
+        if resolved:
+            if resolved.startswith(PACKAGE_PREFIX) or resolved == "metrics_tpu":
+                hit = graph.resolve_method(resolved, fn.attr)
+                if hit:
+                    return hit
+                return graph.function_by_dotted(f"{resolved}.{fn.attr}")
+            return []
+        if recv.id in local_classes:
+            return graph.resolve_method(f"{unit.dotted}.{recv.id}", fn.attr)
+        return []
+    # self.attr.method(...) with a constructor-typed attribute
+    if (
+        isinstance(recv, ast.Attribute)
+        and isinstance(recv.value, ast.Name)
+        and recv.value.id in ("self", "cls")
+        and caller.cls is not None
+    ):
+        info = graph._class_by_rel_qual.get((unit.rel, caller.cls))
+        if info is not None:
+            ctor = info.attr_types.get(recv.attr)
+            if ctor:
+                return graph.resolve_method(ctor, fn.attr)
+        return []
+    # module.sub.fn(...) through the import table
+    resolved = unit.resolve(fn)
+    if resolved and resolved.startswith(PACKAGE_PREFIX):
+        hit = graph.function_by_dotted(resolved)
+        if hit:
+            return hit
+        head, _, tail = resolved.rpartition(".")
+        return graph.resolve_method(head, tail)
+    return []
+
+
+def get_call_graph(ctx: AnalysisContext) -> CallGraph:
+    """The per-run cached graph — every pass shares one build."""
+    graph = ctx.scratch.get(_SCRATCH_KEY)
+    if graph is None:
+        graph = build_call_graph(ctx.units)
+        ctx.scratch[_SCRATCH_KEY] = graph
+    return graph

@@ -451,11 +451,19 @@ def run_passes(
     units: Optional[List[ModuleUnit]] = None,
     baseline_path: Optional[str] = BASELINE_PATH,
     collect_all: bool = False,
+    only: Optional[Set[str]] = None,
 ) -> Report:
     """Run the selected passes (default: all) over the package walk.
 
     ``collect_all=True`` skips baseline filtering (used by
     ``--update-baseline``, which needs the raw findings).
+
+    ``only`` restricts per-module analysis (``check_module``) to the given
+    rel paths while every unit stays visible to the cross-module ``finish``
+    halves — the call graph and lazy scans still see the whole package, so
+    transitive findings rooted in a restricted module keep their full
+    provenance.  This is the ``--changed`` substrate; the full run remains
+    the authority.
     """
     # ensure the bundled passes are registered even when the caller imported
     # engine directly
@@ -465,6 +473,10 @@ def run_passes(
     if units is None:
         units = discover_units(root)
     ctx = AnalysisContext(units, root)
+    if only is not None:
+        # incremental runs must not import the live package (jax): passes
+        # with a live-probe half skip it here, like they do in fixture mode
+        ctx.scratch["incremental_mode"] = True
     selected = list(pass_names) if pass_names else sorted(PASSES)
     unknown = [n for n in selected if n not in PASSES]
     if unknown:
@@ -477,6 +489,8 @@ def run_passes(
             raw.extend(p.check_package(ctx))
             continue
         for unit in units:
+            if only is not None and unit.rel not in only:
+                continue
             if unit.skips(p.name) or not p.applies(unit):
                 continue
             if unit.tree is None:
@@ -551,4 +565,50 @@ def analyze_source(
     for f in p.finish(ctx):
         if not unit.ignored(p.name, f.lineno):
             out.append(f)
+    return out
+
+
+def analyze_sources(
+    pass_name: str,
+    sources: Dict[str, str],
+) -> List[Finding]:
+    """Run ONE AST pass over a dict of ``{rel path: source}`` pretend modules.
+
+    The multi-module sibling of :func:`analyze_source`, for fixtures that
+    exercise cross-module behavior (transitive lock chains, traced regions
+    leaking across imports): all units share one context, so the call graph
+    links them exactly as a real package walk would.
+    """
+    from tools.analyze import passes as _passes  # noqa: F401
+
+    p = PASSES[pass_name]
+    if p.kind != "ast":
+        raise ValueError(f"pass {pass_name!r} is dynamic; analyze_sources needs an AST pass")
+    units = [ModuleUnit(rel, source) for rel, source in sorted(sources.items())]
+    ctx = AnalysisContext(units, REPO_ROOT)
+    ctx.scratch["fixture_mode"] = True  # passes skip live-package halves
+    out: List[Finding] = []
+    for unit in units:
+        if unit.skips(p.name) or not p.applies(unit):
+            continue
+        if unit.tree is None:
+            err = unit.parse_error
+            out.append(
+                p.finding(
+                    unit.rel,
+                    (err.lineno or 0) if err else 0,
+                    "syntax-error",
+                    "parse",
+                    f"does not parse: {err and err.msg}",
+                )
+            )
+            continue
+        out.extend(
+            f for f in p.check_module(unit, ctx) if not unit.ignored(p.name, f.lineno)
+        )
+    for f in p.finish(ctx):
+        unit = ctx.unit(f.module)
+        if unit is not None and (unit.skips(p.name) or unit.ignored(p.name, f.lineno)):
+            continue
+        out.append(f)
     return out

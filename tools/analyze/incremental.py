@@ -1,0 +1,192 @@
+"""Incremental (``--changed``) mode for the analysis CLI.
+
+The pre-commit path: re-analyze only the modules that changed since the
+last run, plus everything that can reach them through the call graph, and
+splice the fresh findings into the cached ones for the rest of the
+package.
+
+Change detection is two-layered:
+
+* ``git diff --name-only HEAD`` (plus untracked files) names what differs
+  from the committed tree — this is what a pre-commit hook cares about.
+* A content-hash cache (``tools/analyze/.cache.json``, not checked in)
+  remembers the exact source each module had when it was last analyzed.
+  A file git reports as changed but whose hash matches the cache was
+  already analyzed in this exact state and is skipped, so the warm
+  no-change invocation does no AST work at all and finishes well under
+  two seconds.
+
+A dirty module's *dependents* — the transitive reverse module-dependency
+closure from the call graph — are re-analyzed with it, because the
+cross-module passes (lock-order chains, trace-safety regions,
+serve-blocking reachability) can change their findings when a callee
+changes.  The ``finish`` halves still see the whole package (see
+``run_passes(only=...)``), so provenance chains stay complete.
+
+Dynamic passes (lock-witness, state-race) are skipped here on purpose:
+they import jax and drive the serve burst, which blows the fast-path
+budget.  The full ``python -m tools.analyze`` run remains the authority;
+``--changed`` is the quick gate in front of it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tools.analyze import engine
+from tools.analyze.callgraph import build_call_graph
+
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache.json")
+_CACHE_VERSION = 1
+
+
+def _hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8")).hexdigest()[:16]
+
+
+def git_changed_rels(root: str) -> Optional[Set[str]]:
+    """Package ``.py`` rels that differ from HEAD (tracked diff + untracked).
+
+    ``None`` when git is unavailable (not a repo, no binary) — callers fall
+    back to pure hash-based detection, which is a superset anyway.
+    """
+    rels: Set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True, timeout=30.0
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        rels.update(
+            line.strip()
+            for line in out.stdout.splitlines()
+            if line.strip().startswith(engine.PACKAGE + "/")
+            and line.strip().endswith(".py")
+        )
+    return rels
+
+
+def _load_cache(path: str, static_passes: List[str]) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if data.get("version") != _CACHE_VERSION or data.get("passes") != static_passes:
+        return None  # pass set changed: cached findings are not comparable
+    return data
+
+
+def _save_cache(
+    path: str,
+    static_passes: List[str],
+    hashes: Dict[str, str],
+    raw: List[engine.Finding],
+) -> None:
+    payload = {
+        "version": _CACHE_VERSION,
+        "passes": static_passes,
+        "hashes": hashes,
+        "findings": [f.to_json() for f in raw],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def run_changed(
+    root: Optional[str] = None,
+    cache_path: str = CACHE_PATH,
+    baseline_path: Optional[str] = engine.BASELINE_PATH,
+) -> Tuple[engine.Report, Dict[str, Any]]:
+    """Incremental static run; returns ``(report, info)``.
+
+    ``info`` carries the incremental telemetry the CLI prints: which rels
+    were dirty, how many dependents rode along, and whether the cache was
+    warm.  The report is shaped exactly like a full run's so callers (and
+    exit-code logic) need not care which mode produced it.
+    """
+    from tools.analyze import passes as _passes  # noqa: F401  (register)
+
+    root = os.path.abspath(root or engine.REPO_ROOT)
+    static_passes = sorted(
+        n for n, p in engine.PASSES.items() if p.kind == "ast"
+    )
+    units = engine.discover_units(root)
+    hashes = {u.rel: _hash(u.source) for u in units}
+    cache = _load_cache(cache_path, static_passes)
+    git_rels = git_changed_rels(root)
+
+    if cache is None:
+        # cold start: full static run seeds the cache
+        report = engine.run_passes(static_passes, root=root, units=units,
+                                   baseline_path=None, collect_all=True)
+        raw = report.findings
+        dirty: Set[str] = set(hashes)
+        affected: Set[str] = set(hashes)
+        warm = False
+    else:
+        cached_hashes: Dict[str, str] = cache.get("hashes", {})
+        dirty = {rel for rel, h in hashes.items() if cached_hashes.get(rel) != h}
+        cached_raw = [
+            engine.Finding(**f)
+            for f in cache.get("findings", [])
+            if f.get("module") in hashes  # drop findings of deleted modules
+        ]
+        if not dirty:
+            raw = cached_raw
+            affected = set()
+            warm = True
+        else:
+            graph = build_call_graph(units)
+            affected = dirty | graph.dependents(dirty)
+            report = engine.run_passes(static_passes, root=root, units=units,
+                                       baseline_path=None, collect_all=True,
+                                       only=affected)
+            # fresh findings own every affected module; keep the cache's
+            # findings for the rest, deduping cross-module emissions that
+            # exist on both sides (frozen dataclass == full-tuple equality)
+            kept = [f for f in cached_raw if f.module not in affected]
+            raw = list(dict.fromkeys(kept + report.findings))
+            warm = False
+
+    _save_cache(cache_path, static_passes, hashes, raw)
+
+    baseline = engine.load_baseline(baseline_path) if baseline_path else {}
+    fresh, suppressed = engine.split_baselined(raw, baseline)
+    per_pass = {name: {"findings": 0, "baselined": 0} for name in static_passes}
+    for f in fresh:
+        per_pass.setdefault(f.pass_name, {"findings": 0, "baselined": 0})
+        per_pass[f.pass_name]["findings"] += 1
+    for f in suppressed:
+        per_pass.setdefault(f.pass_name, {"findings": 0, "baselined": 0})
+        per_pass[f.pass_name]["baselined"] += 1
+    report = engine.Report(
+        findings=fresh,
+        baselined=suppressed,
+        per_pass=per_pass,
+        modules_analyzed=len(affected),
+    )
+    info = {
+        "warm": warm,
+        "dirty": sorted(dirty),
+        "analyzed": len(affected),
+        "dependents": len(affected - dirty),
+        "git_changed": sorted(git_rels) if git_rels is not None else None,
+        "static_passes": static_passes,
+    }
+    return report, info

@@ -15,3 +15,7 @@ from tools.analyze.passes import (  # noqa: F401
     state_contract,
     trace_safety,
 )
+
+# the dynamic runtime-sanitizer passes (lock-witness, state-race) live under
+# tools.analyze.runtime with their instrumentation substrate
+from tools.analyze import runtime  # noqa: E402,F401

@@ -11,19 +11,28 @@ fix:
   not contain a 2-cycle: if one code path takes A then B and another takes
   B then A, two threads can deadlock.  Lock identity is the normalized
   acquisition expression (``self.`` stripped), so ``job.lock`` in one
-  module and ``self.lock`` in another unify per attribute path.
+  module and ``self.lock`` in another unify per attribute path.  Since the
+  call-graph migration the edges are **transitive**: a function that calls
+  (through any resolvable chain, bounded by :attr:`LockOrderPass.depth`)
+  into a function that acquires a lock contributes the edge, with the full
+  chain carried as provenance.
 * **blocking-under-lock** — while any lock is held, no call may park the
   thread on something unbounded: collectives / barriers / KV waits /
   checkpoint commits (the serve-blocking vocabulary), untimed
   ``queue.put``/``queue.get`` (a dead consumer never drains the queue —
   exactly the PR-7 flush hang), zero-argument ``.wait()`` / ``.join()``,
-  ``time.sleep``, and socket/HTTP reads.  One same-module call hop is
-  followed: a call under a lock to a local function that itself blocks is
-  flagged at the call site.
+  ``time.sleep``, and socket/HTTP reads.  Calls under a lock are closed
+  over the whole-package call graph (``tools/analyze/callgraph.py``): a
+  chain like ``EvalServer.checkpoint_now -> EvalServer.flush ->
+  IngestQueue.put_control`` is followed to the blocking primitive and the
+  finding prints the chain (rule ``blocking-callee-under-lock``).
 
 Deliberate quiesce points (the durability loop's save/restore under
 ``registry.locked()``, the soak harness' operator sync under a job lock)
-are baselined with justifications rather than silenced in code.
+are baselined with justifications rather than silenced in code.  The
+runtime sibling of this pass — ``lock-witness`` under
+``tools/analyze/runtime/`` — wraps live locks and checks the same two
+rules against what the serve suite actually does.
 """
 
 from __future__ import annotations
@@ -45,6 +54,9 @@ from tools.analyze.passes.serve_blocking import BLOCKING_CALLS as COLLECTIVE_CAL
 SOCKET_CALLS = {"urlopen", "recv", "accept", "connect", "sendall", "getresponse"}
 
 _SCRATCH = "lock-order"
+
+# call edges followed from a lock-held call site before the search gives up
+DEFAULT_DEPTH = 4
 
 
 def _lock_id(expr: ast.AST) -> Optional[str]:
@@ -111,11 +123,16 @@ def _blocking_reason(call: ast.Call, unit: ModuleUnit) -> Optional[str]:
 
 
 class _FnScan:
-    """Per-function results: findings plus call-graph hooks."""
+    """Per-function facts the cross-module closure consumes."""
+
+    __slots__ = ("direct_blocking", "acquisitions", "held_calls")
 
     def __init__(self) -> None:
-        self.direct_blocking: Optional[str] = None  # first blocking primitive
-        self.calls_under_lock: List[Tuple[str, int, Tuple[str, ...]]] = []
+        self.direct_blocking: Optional[Tuple[str, int]] = None  # (reason, line)
+        # every acquisition event in this function: (lock, lineno)
+        self.acquisitions: List[Tuple[str, int]] = []
+        # call sites executed while holding locks: lineno -> held tuple
+        self.held_calls: Dict[int, Tuple[str, ...]] = {}
 
 
 @register_pass
@@ -124,64 +141,26 @@ class LockOrderPass(AnalysisPass):
     description = (
         "no inconsistent lock-acquisition order anywhere in the package, "
         "and nothing blocking (collective, untimed queue op, bare wait/join, "
-        "sleep, socket) is called while a lock is held"
+        "sleep, socket) is reachable through the call graph while a lock is "
+        "held"
     )
+
+    def __init__(self) -> None:
+        self.depth = DEFAULT_DEPTH
 
     def applies(self, unit: ModuleUnit) -> bool:
         return "lock" in unit.source.lower()
 
     # ----------------------------------------------------------- per module
     def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
-        scratch = ctx.scratch.setdefault(
-            _SCRATCH, {"edges": {}}
-        )
-        edges: Dict[Tuple[str, str], Tuple[str, int]] = scratch["edges"]
+        from tools.analyze.callgraph import collect_functions
+
+        scratch = ctx.scratch.setdefault(_SCRATCH, {"edges": {}, "scans": {}})
         problems: List[Finding] = []
-
-        fns: List[Tuple[str, Optional[str], ast.AST]] = []
-
-        def collect(node: ast.AST, scope: str, cls: Optional[str]) -> None:
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    qual = f"{scope}.{child.name}" if scope else child.name
-                    fns.append((qual, cls, child))
-                    collect(child, qual, None)
-                elif isinstance(child, ast.ClassDef):
-                    qual = f"{scope}.{child.name}" if scope else child.name
-                    collect(child, qual, qual)
-                else:
-                    collect(child, scope, cls)
-
-        collect(unit.tree, "", None)
-        by_simple: Dict[str, List[Tuple[str, Optional[str]]]] = {}
-        for qual, cls, _node in fns:
-            by_simple.setdefault(qual.rsplit(".", 1)[-1], []).append((qual, cls))
-
-        scans: Dict[str, _FnScan] = {}
-        for qual, cls, node in fns:
-            scans[qual] = self._scan_function(unit, qual, cls, node, edges, problems)
-
-        # one-hop propagation: a call under a lock to a local function that
-        # itself blocks is a blocking call at the call site
-        for qual, scan in scans.items():
-            for callee_name, lineno, held in scan.calls_under_lock:
-                caller_cls = next(c for q, c, _n in fns if q == qual)
-                for callee_qual, callee_cls in by_simple.get(callee_name, []):
-                    if callee_cls is not None and callee_cls != caller_cls:
-                        continue
-                    reason = scans[callee_qual].direct_blocking
-                    if reason:
-                        problems.append(
-                            self.finding(
-                                unit.rel,
-                                lineno,
-                                "blocking-callee-under-lock",
-                                f"{qual}:{callee_name}",
-                                f"`{callee_name}()` (which blocks: {reason}) is "
-                                f"called while holding {list(held)}",
-                            )
-                        )
-                        break
+        funcs, _classes = collect_functions(unit.tree, unit.rel)
+        for f in funcs:
+            scan = self._scan_function(unit, f.qualname, f.node, scratch["edges"], problems)
+            scratch["scans"][f.fid] = scan
         return problems
 
     # --------------------------------------------------------- one function
@@ -189,17 +168,17 @@ class LockOrderPass(AnalysisPass):
         self,
         unit: ModuleUnit,
         qual: str,
-        cls: Optional[str],
         fn: ast.AST,
-        edges: Dict[Tuple[str, str], Tuple[str, int]],
+        edges: Dict[Tuple[str, str], Tuple[str, int, Optional[str]]],
         problems: List[Finding],
     ) -> _FnScan:
         scan = _FnScan()
 
         def record_acquisition(lock: str, held: Tuple[str, ...], lineno: int) -> None:
+            scan.acquisitions.append((lock, lineno))
             for h in held:
                 if h != lock:
-                    edges.setdefault((h, lock), (unit.rel, lineno))
+                    edges.setdefault((h, lock), (unit.rel, lineno, None))
 
         def check_call(call: ast.Call, held: Tuple[str, ...]) -> None:
             # standalone .acquire() is an acquisition event (release untracked)
@@ -210,7 +189,7 @@ class LockOrderPass(AnalysisPass):
                     return
             reason = _blocking_reason(call, unit)
             if reason and scan.direct_blocking is None:
-                scan.direct_blocking = reason
+                scan.direct_blocking = (reason, call.lineno)
             if held:
                 if reason:
                     attr = (
@@ -228,12 +207,9 @@ class LockOrderPass(AnalysisPass):
                             "release the lock first or bound the wait",
                         )
                     )
-                elif isinstance(call.func, ast.Name):
-                    scan.calls_under_lock.append((call.func.id, call.lineno, held))
-                elif isinstance(call.func, ast.Attribute) and isinstance(
-                    call.func.value, ast.Name
-                ) and call.func.value.id in ("self", "cls"):
-                    scan.calls_under_lock.append((call.func.attr, call.lineno, held))
+                else:
+                    # the call-graph closure (finish) follows this site
+                    scan.held_calls.setdefault(call.lineno, held)
 
         def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
@@ -260,26 +236,113 @@ class LockOrderPass(AnalysisPass):
             visit(stmt, ())
         return scan
 
+    # ----------------------------------------------- lazy cross-module scans
+    def _scan_for(self, fid: str, ctx: AnalysisContext) -> Optional[_FnScan]:
+        """The scan for a reached function, scanning on demand when its
+        module was prefiltered out by ``applies`` (no "lock" in source)."""
+        from tools.analyze.callgraph import get_call_graph
+
+        scratch = ctx.scratch.setdefault(_SCRATCH, {"edges": {}, "scans": {}})
+        scans: Dict[str, _FnScan] = scratch["scans"]
+        if fid in scans:
+            return scans[fid]
+        graph = get_call_graph(ctx)
+        node = graph.node(fid)
+        if node is None:
+            return None
+        unit = ctx.unit(node.rel)
+        if unit is None or unit.tree is None:
+            return None
+        # a module without "lock" in its source cannot hold locks, so the
+        # direct problems list is always empty here — discard it
+        scan = self._scan_function(unit, node.qualname, node.node, scratch["edges"], [])
+        scans[fid] = scan
+        return scan
+
     # ------------------------------------------------------------ aggregate
     def finish(self, ctx: AnalysisContext) -> List[Finding]:
+        from tools.analyze.callgraph import get_call_graph
+
         scratch = ctx.scratch.get(_SCRATCH)
         if not scratch:
             return []
-        edges: Dict[Tuple[str, str], Tuple[str, int]] = scratch["edges"]
+        graph = get_call_graph(ctx)
+        edges: Dict[Tuple[str, str], Tuple[str, int, Optional[str]]] = scratch["edges"]
         problems: List[Finding] = []
-        for (a, b), (module, lineno) in sorted(edges.items()):
+
+        # close every lock-held call site over the call graph
+        for fid, scan in sorted(scratch["scans"].items()):
+            if not scan.held_calls:
+                continue
+            caller = graph.node(fid)
+            if caller is None:
+                continue
+            for lineno, held in sorted(scan.held_calls.items()):
+                starts = [
+                    (e.callee, e.lineno)
+                    for e in graph.out.get(fid, ())
+                    if e.lineno == lineno
+                ]
+                if not starts:
+                    continue
+                reached = graph.chains(starts, depth=self.depth - 1)
+                blocking: List[Tuple[int, str, List[Tuple[str, int]], str]] = []
+                for callee_fid, chain in reached.items():
+                    callee_scan = self._scan_for(callee_fid, ctx)
+                    if callee_scan is None:
+                        continue
+                    if callee_scan.direct_blocking is not None:
+                        blocking.append(
+                            (len(chain), callee_fid, chain, callee_scan.direct_blocking[0])
+                        )
+                    # transitive acquisition: every lock taken down the chain
+                    # is taken while the caller's held set is still held
+                    for lock, acq_lineno in callee_scan.acquisitions:
+                        chain_str = (
+                            f"{caller.qualname} -> {graph.render_chain(chain)}"
+                        )
+                        for h in held:
+                            if h != lock:
+                                edges.setdefault(
+                                    (h, lock), (caller.rel, lineno, chain_str)
+                                )
+                # one finding per call site: the shortest chain to a blocker
+                if blocking:
+                    blocking.sort(key=lambda item: (item[0], item[1]))
+                    _, callee_fid, chain, reason = blocking[0]
+                    chain_quals = [graph.display(c) for c, _ in chain]
+                    problems.append(
+                        self.finding(
+                            caller.rel,
+                            lineno,
+                            "blocking-callee-under-lock",
+                            f"{caller.qualname}:{'->'.join(chain_quals)}",
+                            f"`{chain_quals[-1]}()` blocks ({reason}); reached "
+                            f"via {caller.qualname} -> "
+                            f"{' -> '.join(chain_quals)} while holding "
+                            f"{list(held)}",
+                        )
+                    )
+
+        # 2-cycles in the aggregated acquisition graph
+        for (a, b), (module, lineno, chain) in sorted(edges.items()):
             if a < b and (b, a) in edges:
-                other_mod, other_line = edges[(b, a)]
+                other_mod, other_line, other_chain = edges[(b, a)]
+                here = f"here ({chain})" if chain else "here"
+                there = (
+                    f"{other_mod}:{other_line}"
+                    + (f" ({other_chain})" if other_chain else "")
+                )
                 problems.append(
                     self.finding(
                         module,
                         lineno,
                         "inconsistent-order",
                         f"{a}->{b}",
-                        f"lock `{b}` is acquired while holding `{a}` here, but "
-                        f"{other_mod}:{other_line} acquires `{a}` while holding "
-                        f"`{b}` — two threads on these paths can deadlock; pick "
-                        "one global order",
+                        f"lock `{b}` is acquired while holding `{a}` {here}, "
+                        f"but {there} acquires `{a}` while holding `{b}` — "
+                        "two threads on these paths can deadlock; pick one "
+                        "global order",
                     )
                 )
         return problems

@@ -15,6 +15,16 @@ opts out.  ``server.py`` (the durability loop checkpoints, which barriers
 across ranks by design) and ``soak.py`` (the harness fires explicit
 operator syncs) carry ``# analyze: skip-file[serve-blocking]`` markers.
 
+Since the call-graph migration the lexical rule has a transitive sibling,
+``blocking-reachable``: a request-path function whose resolvable call
+chain (``tools/analyze/callgraph.py``, bounded by
+:attr:`ServeBlockingPass.depth`) lands in a function that *spells* a
+blocking primitive — wherever that function lives — is reported with the
+full chain, closing the "hide the collective behind one hop" loophole the
+old one-module lint had.  The finding lands on the request-path module
+(the root owns its transitive behavior), so ``skip-file`` opt-outs keep
+their meaning.
+
 This pass is the ported ``tools/serve_lint.py`` (its module entry point
 remains as a shim).
 """
@@ -22,7 +32,7 @@ remains as a shim).
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import Dict, List, Optional
 
 from tools.analyze.engine import (
     AnalysisContext,
@@ -34,6 +44,11 @@ from tools.analyze.engine import (
 )
 
 SCOPE_PREFIX = "metrics_tpu/serve/"
+
+_SCRATCH = "serve-blocking"
+
+# call edges followed below a request-path function before the search stops
+DEFAULT_DEPTH = 4
 
 # call names that block on peers: collectives, barriers, KV-store waits,
 # checkpoint commits (which barrier internally), and explicit metric syncs
@@ -81,14 +96,22 @@ class ServeBlockingPass(AnalysisPass):
     name = "serve-blocking"
     description = (
         "serve request-path modules spell no blocking collective, barrier, "
-        "KV wait, or checkpoint commit, and never import the distributed "
-        "machinery"
+        "KV wait, or checkpoint commit (directly or through any resolvable "
+        "call chain), and never import the distributed machinery"
     )
+
+    def __init__(self) -> None:
+        self.depth = DEFAULT_DEPTH
 
     def applies(self, unit: ModuleUnit) -> bool:
         return unit.rel.startswith(SCOPE_PREFIX)
 
     def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        from tools.analyze.callgraph import collect_functions
+
+        scratch = ctx.scratch.setdefault(_SCRATCH, {"roots": []})
+        funcs, _classes = collect_functions(unit.tree, unit.rel)
+        scratch["roots"].extend(f.fid for f in funcs)
         problems: List[Finding] = []
         for node, scope in walk_with_scope(unit.tree):
             where = scope or "<module>"
@@ -128,4 +151,69 @@ class ServeBlockingPass(AnalysisPass):
                                 "modules",
                             )
                         )
+        return problems
+
+    # ------------------------------------------------------------- closure
+    def _spelled_blocking(self, fid: str, ctx: AnalysisContext) -> Optional[str]:
+        """The first blocking-call name a function's own body spells, cached."""
+        from tools.analyze.callgraph import body_nodes, get_call_graph
+
+        scratch = ctx.scratch.setdefault(_SCRATCH, {"roots": []})
+        cache: Dict[str, Optional[str]] = scratch.setdefault("spells", {})
+        if fid in cache:
+            return cache[fid]
+        node = get_call_graph(ctx).node(fid)
+        spelled: Optional[str] = None
+        if node is not None:
+            for n in body_nodes(node.node):
+                if isinstance(n, ast.Call) and _call_name(n) in BLOCKING_CALLS:
+                    spelled = _call_name(n)
+                    break
+        cache[fid] = spelled
+        return spelled
+
+    def finish(self, ctx: AnalysisContext) -> List[Finding]:
+        from tools.analyze.callgraph import get_call_graph
+
+        scratch = ctx.scratch.get(_SCRATCH)
+        if not scratch or not scratch["roots"]:
+            return []
+        graph = get_call_graph(ctx)
+        problems: List[Finding] = []
+        for root_fid in sorted(scratch["roots"]):
+            root = graph.node(root_fid)
+            if root is None:
+                continue
+            reached = graph.chains([(root_fid, 0)], depth=self.depth)
+            for callee_fid in sorted(reached):
+                if callee_fid == root_fid:
+                    continue  # the lexical rule already owns direct spellings
+                callee = graph.node(callee_fid)
+                if callee is None:
+                    continue
+                callee_unit = ctx.unit(callee.rel)
+                if (
+                    callee.rel.startswith(SCOPE_PREFIX)
+                    and callee_unit is not None
+                    and not callee_unit.skips(self.name)
+                ):
+                    continue  # in-scope callee: its own lexical findings cover it
+                name = self._spelled_blocking(callee_fid, ctx)
+                if name is None:
+                    continue
+                chain = reached[callee_fid]
+                chain_quals = [graph.display(c) for c, _ in chain[1:]]
+                problems.append(
+                    self.finding(
+                        root.rel,
+                        chain[1][1] if len(chain) > 1 else root.lineno,
+                        "blocking-reachable",
+                        f"{root.qualname}->{callee.qualname}:{name}",
+                        f"request path `{root.qualname}` reaches `{name}(...)` "
+                        f"via {root.qualname} -> {' -> '.join(chain_quals)} — "
+                        "blocking on a peer one hop away is still blocking; "
+                        "move the call behind the durability loop or an "
+                        "operator action",
+                    )
+                )
         return problems

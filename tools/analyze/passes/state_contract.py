@@ -19,6 +19,13 @@ package:
   ``(num_streams, ...)`` axis) must not register list or buffer states:
   growing states have no fixed-shape per-stream stacked form, so the
   annotation and the registration contradict each other.
+* **stackability coverage** (rule ``stackable-unannotated``) — every
+  metric class under ``classification/`` and ``regression/`` that
+  registers state must say whether it stacks: an explicit ``stackable =
+  True/False`` in its own body or inherited from a package base (resolved
+  through the call graph's class table).  Unannotated metrics silently
+  fall back to ``None``, which ``MultiStreamMetric`` treats as "probably
+  fine" — this rule turns that silence into a reviewed decision.
 * **serializer coverage** (rules shared with ``ckpt-serializers``) — every
   registration API's kinds are declared to the checkpoint codec; this
   absorbs the old ``ckpt_lint`` static half so one pass owns the
@@ -39,6 +46,35 @@ from tools.analyze.engine import (
 )
 
 _METRIC_REL = "metrics_tpu/metric.py"
+
+# directories where every state-registering metric must carry (or inherit)
+# an explicit stackable annotation
+_ANNOTATED_DIRS = ("metrics_tpu/classification/", "metrics_tpu/regression/")
+_STATE_REGISTRARS = {"add_state", "add_buffer_state", "add_sketch_state"}
+
+
+def _class_stackable(node: ast.ClassDef) -> Optional[bool]:
+    """The class body's own ``stackable`` annotation: True/False when it is
+    an explicit bool constant, None when absent (or the base-class default
+    ``stackable: Optional[bool] = None``, which is not an annotation)."""
+    value: Any = None
+    for stmt in node.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "stackable" for t in stmt.targets
+            )
+            and isinstance(stmt.value, ast.Constant)
+        ):
+            value = stmt.value.value
+        elif (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and stmt.target.id == "stackable"
+            and isinstance(stmt.value, ast.Constant)
+        ):
+            value = stmt.value.value
+    return value if isinstance(value, bool) else None
 
 
 def _const_str(node: Optional[ast.AST]) -> Optional[str]:
@@ -216,25 +252,7 @@ class StateContractPass(AnalysisPass):
         for node in ast.walk(unit.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
-            stackable = None
-            for stmt in node.body:
-                if (
-                    isinstance(stmt, ast.Assign)
-                    and any(
-                        isinstance(t, ast.Name) and t.id == "stackable"
-                        for t in stmt.targets
-                    )
-                    and isinstance(stmt.value, ast.Constant)
-                ):
-                    stackable = stmt.value.value
-                elif (
-                    isinstance(stmt, ast.AnnAssign)
-                    and isinstance(stmt.target, ast.Name)
-                    and stmt.target.id == "stackable"
-                    and isinstance(stmt.value, ast.Constant)
-                ):
-                    stackable = stmt.value.value
-            if stackable is not True:
+            if _class_stackable(node) is not True:
                 continue
             for sub in ast.walk(node):
                 if not isinstance(sub, ast.Call):
@@ -265,16 +283,85 @@ class StateContractPass(AnalysisPass):
                         )
                     )
 
+    # -------------------------------------------- stackability annotation
+    def _unannotated_problems(self, ctx: AnalysisContext) -> List[Finding]:
+        """``stackable-unannotated``: classification/regression metrics that
+        register state must annotate stackability or inherit it."""
+        from tools.analyze.callgraph import get_call_graph
+
+        graph = get_call_graph(ctx)
+        class_defs = {}  # dotted -> ClassDef, for base-body annotation lookup
+        for unit in ctx.units:
+            if unit.tree is None:
+                continue
+            for node in unit.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    class_defs[f"{unit.dotted}.{node.name}"] = node
+
+        def inherits_annotation(dotted: str, seen: set) -> bool:
+            if dotted in seen:
+                return False
+            seen.add(dotted)
+            info = graph.class_info(dotted)
+            if info is None:
+                return False
+            for base in info.bases:
+                base_def = class_defs.get(base)
+                if base_def is not None and _class_stackable(base_def) is not None:
+                    return True
+                if inherits_annotation(base, seen):
+                    return True
+            return False
+
+        problems: List[Finding] = []
+        for unit in ctx.units:
+            if unit.tree is None or not unit.rel.startswith(_ANNOTATED_DIRS):
+                continue
+            for node in unit.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                registers = any(
+                    isinstance(sub, ast.Call)
+                    and (
+                        sub.func.attr
+                        if isinstance(sub.func, ast.Attribute)
+                        else getattr(sub.func, "id", "")
+                    )
+                    in _STATE_REGISTRARS
+                    for sub in ast.walk(node)
+                )
+                if not registers or _class_stackable(node) is not None:
+                    continue
+                if inherits_annotation(f"{unit.dotted}.{node.name}", set()):
+                    continue
+                problems.append(
+                    self.finding(
+                        unit.rel,
+                        node.lineno,
+                        "stackable-unannotated",
+                        f"{node.name}:stackable",
+                        f"metric class {node.name} registers state but neither "
+                        "declares `stackable` nor inherits an annotation — say "
+                        "`stackable = True` (fixed-shape tensor/sketch states "
+                        "only) or `stackable = False` (growing states) so "
+                        "MultiStreamMetric eligibility is a reviewed decision, "
+                        "not a default",
+                        severity="warning",
+                    )
+                )
+        return problems
+
     # ------------------------------------------------- serializer coverage
     def finish(self, ctx: AnalysisContext) -> List[Finding]:
-        if ctx.scratch.get("fixture_mode"):
-            return []  # fixture runs check source snippets, not the live codec
+        problems = self._unannotated_problems(ctx)
+        if ctx.scratch.get("fixture_mode") or ctx.scratch.get("incremental_mode"):
+            return problems  # fixture/--changed runs stay off the live codec
         from tools.analyze.passes.ckpt_serializers import coverage_problems
 
         try:
             rows = coverage_problems()
         except Exception as err:  # the package must import for this half
-            return [
+            return problems + [
                 self.finding(
                     _METRIC_REL,
                     0,
@@ -283,7 +370,7 @@ class StateContractPass(AnalysisPass):
                     f"could not check serializer coverage: {type(err).__name__}: {err}",
                 )
             ]
-        return [
+        return problems + [
             self.finding(_METRIC_REL, 0, rule, detail, message)
             for rule, detail, message in rows
         ]

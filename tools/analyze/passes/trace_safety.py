@@ -12,8 +12,11 @@ shape lint to the whole package:
    with ``jit``/``pjit`` (directly or through ``functools.partial``), passed
    to a ``jax.jit`` / ``pjit`` / ``vmap`` / ``pmap`` / ``lax.cond`` /
    ``lax.scan`` / ``lax.while_loop`` / ... call site (by name, ``self.``
-   attribute, or inline lambda), or statically reachable from such a
-   function through same-module calls;
+   attribute, or inline lambda), or statically reachable from such a root
+   through the whole-package call graph (``tools/analyze/callgraph.py``) —
+   cross-module, depth-bounded by :attr:`TraceSafetyPass.depth`, with the
+   provenance chain printed in the finding (``traced via update ->
+   _merge_bins``);
 2. **host round-trips** inside traced regions: ``.item()`` / ``.tolist()``
    (rule ``host-pull``), ``float()``/``int()``/``bool()`` casts of
    non-constant values (rule ``host-cast`` — shape/ndim/size/len reads are
@@ -29,7 +32,10 @@ shape lint to the whole package:
 
 Deliberately-eager paths (the detection host kernels, the native ctypes
 shims, serve I/O) are allowlisted below; one-off eager lines inside traced
-modules use ``# analyze: ignore[trace-safety]`` with a reason.
+modules use ``# analyze: ignore[trace-safety]`` with a reason.  Functions
+the closure reaches inside an allowlisted module are not reported either —
+crossing into a host kernel is the *call site's* problem, and the
+serve-blocking pass owns that boundary.
 """
 
 from __future__ import annotations
@@ -106,38 +112,10 @@ EAGER_ALLOWLIST = (
     "metrics_tpu/serve/traffic.py",  # traffic generator is host-side
 )
 
+_SCRATCH = "trace-safety"
 
-class _FnInfo:
-    __slots__ = ("node", "qualname", "cls", "simple")
-
-    def __init__(self, node: ast.AST, qualname: str, cls: Optional[str]) -> None:
-        self.node = node
-        self.qualname = qualname
-        self.cls = cls
-        self.simple = qualname.rsplit(".", 1)[-1]
-
-
-def _collect_functions(tree: ast.Module) -> List[_FnInfo]:
-    out: List[_FnInfo] = []
-
-    def visit(node: ast.AST, scope: str, cls: Optional[str]) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = f"{scope}.{child.name}" if scope else child.name
-                out.append(_FnInfo(child, qual, cls))
-                visit(child, qual, None)
-            elif isinstance(child, ast.ClassDef):
-                qual = f"{scope}.{child.name}" if scope else child.name
-                visit(child, qual, qual)
-            elif isinstance(child, ast.Lambda):
-                qual = f"{scope}.<lambda@{child.lineno}>" if scope else f"<lambda@{child.lineno}>"
-                out.append(_FnInfo(child, qual, cls))
-                visit(child, qual, None)
-            else:
-                visit(child, scope, cls)
-
-    visit(tree, "", None)
-    return out
+# call edges followed below a traced root before the closure gives up
+DEFAULT_DEPTH = 6
 
 
 def _body_nodes(fn: ast.AST):
@@ -284,28 +262,36 @@ def _test_is_exempt(test: ast.AST) -> bool:
 class TraceSafetyPass(AnalysisPass):
     name = "trace-safety"
     description = (
-        "functions reachable from jit/pjit/vmap call sites contain no host "
-        "round-trips (.item()/float()/np.asarray) or Python branches on "
-        "traced values"
+        "functions reachable from jit/pjit/vmap call sites (closed over the "
+        "whole-package call graph) contain no host round-trips "
+        "(.item()/float()/np.asarray) or Python branches on traced values"
     )
+
+    def __init__(self) -> None:
+        self.depth = DEFAULT_DEPTH
 
     def applies(self, unit: ModuleUnit) -> bool:
         return not unit.rel.startswith(EAGER_ALLOWLIST)
 
     # ------------------------------------------------------------ discovery
-    def _traced_functions(self, unit: ModuleUnit) -> Dict[str, _FnInfo]:
+    def _module_roots(self, unit: ModuleUnit) -> Set[str]:
+        """Traced-region ROOTS of one module: decorated functions plus
+        function-position arguments of trace-wrapper call sites.  The
+        closure below them happens in :meth:`finish`, over the package
+        call graph rather than this module alone."""
+        from tools.analyze.callgraph import collect_functions
+
         tree = unit.tree
-        fns = _collect_functions(tree)
-        by_node = {id(f.node): f for f in fns}
-        by_simple: Dict[str, List[_FnInfo]] = {}
-        for f in fns:
-            by_simple.setdefault(f.simple, []).append(f)
+        funcs, _classes = collect_functions(tree, unit.rel)
+        by_node = {id(f.node): f for f in funcs}
+        by_simple: Dict[str, List[str]] = {}
+        for f in funcs:
+            by_simple.setdefault(f.simple, []).append(f.fid)
 
         roots: Set[str] = set()
 
         def mark_name(name: str) -> None:
-            for f in by_simple.get(name, []):
-                roots.add(f.qualname)
+            roots.update(by_simple.get(name, ()))
 
         def mark_arg(arg: ast.AST) -> None:
             if isinstance(arg, ast.Name):
@@ -315,27 +301,27 @@ class TraceSafetyPass(AnalysisPass):
             elif isinstance(arg, ast.Lambda):
                 info = by_node.get(id(arg))
                 if info is not None:
-                    roots.add(info.qualname)
+                    roots.add(info.fid)
             elif isinstance(arg, (ast.List, ast.Tuple)):  # lax.switch branches
                 for elt in arg.elts:
                     mark_arg(elt)
 
         # decorators
-        for f in fns:
+        for f in funcs:
             if not isinstance(f.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             for dec in f.node.decorator_list:
                 target = dec.func if isinstance(dec, ast.Call) else dec
                 resolved = unit.resolve(target)
                 if resolved in TRACE_WRAPPERS:
-                    roots.add(f.qualname)
+                    roots.add(f.fid)
                 elif (
                     isinstance(dec, ast.Call)
                     and resolved == "functools.partial"
                     and dec.args
                     and unit.resolve(dec.args[0]) in TRACE_WRAPPERS
                 ):
-                    roots.add(f.qualname)
+                    roots.add(f.fid)
 
         # call sites: jax.jit(fn), lax.cond(p, true_fn, false_fn, ...) etc. —
         # only the function-position arguments, never the operands
@@ -352,66 +338,69 @@ class TraceSafetyPass(AnalysisPass):
                 if kw.arg in FUNC_KWARG_NAMES and kw.value is not None:
                     mark_arg(kw.value)
 
-        # same-module reachability: a fn called from a traced fn is traced
-        edges: Dict[str, Set[str]] = {f.qualname: set() for f in fns}
-        for f in fns:
-            for node in _body_nodes(f.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                if isinstance(node.func, ast.Name):
-                    for g in by_simple.get(node.func.id, []):
-                        edges[f.qualname].add(g.qualname)
-                elif isinstance(node.func, ast.Attribute) and isinstance(
-                    node.func.value, ast.Name
-                ) and node.func.value.id in ("self", "cls"):
-                    for g in by_simple.get(node.func.attr, []):
-                        if g.cls is not None and g.cls == f.cls:
-                            edges[f.qualname].add(g.qualname)
+        return roots
 
-        traced: Set[str] = set()
-        frontier = list(roots)
-        while frontier:
-            qual = frontier.pop()
-            if qual in traced:
+    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
+        scratch = ctx.scratch.setdefault(_SCRATCH, {"roots": []})
+        scratch["roots"].extend((fid, 0) for fid in sorted(self._module_roots(unit)))
+        return []
+
+    # -------------------------------------------------------------- closure
+    def finish(self, ctx: AnalysisContext) -> List[Finding]:
+        from tools.analyze.callgraph import get_call_graph
+
+        scratch = ctx.scratch.get(_SCRATCH)
+        if not scratch or not scratch["roots"]:
+            return []
+        graph = get_call_graph(ctx)
+        reached = graph.chains(scratch["roots"], depth=self.depth)
+        problems: List[Finding] = []
+        for fid in sorted(reached):
+            node = graph.node(fid)
+            if node is None or node.rel.startswith(EAGER_ALLOWLIST):
+                continue  # crossing into a host kernel is the call site's call
+            unit = ctx.unit(node.rel)
+            if unit is None:
                 continue
-            traced.add(qual)
-            frontier.extend(edges.get(qual, ()))
-        return {f.qualname: f for f in fns if f.qualname in traced}
+            chain = reached[fid]
+            via = (
+                f" (traced via {graph.render_chain(chain)})" if len(chain) > 1 else ""
+            )
+            problems.extend(self._check_function(unit, node.qualname, node.node, via))
+        return problems
 
     # -------------------------------------------------------------- checks
-    def check_module(self, unit: ModuleUnit, ctx: AnalysisContext) -> List[Finding]:
-        traced = self._traced_functions(unit)
-        if not traced:
-            return []
+    def _check_function(
+        self, unit: ModuleUnit, qual: str, fn: ast.AST, via: str
+    ) -> List[Finding]:
         problems: List[Finding] = []
-        for qual, info in sorted(traced.items()):
-            arrayish = _arrayish_names(info.node, unit)
-            for node in _body_nodes(info.node):
-                if isinstance(node, ast.Call):
-                    problems.extend(self._check_call(unit, qual, node, arrayish))
-                elif isinstance(node, (ast.If, ast.While)):
-                    kind = "if" if isinstance(node, ast.If) else "while"
-                    test = node.test
-                    if _test_is_exempt(test):
-                        continue
-                    used = {
-                        n.id for n in ast.walk(test) if isinstance(n, ast.Name)
-                    } & arrayish
-                    if used:
-                        problems.append(
-                            self.finding(
-                                unit.rel,
-                                node.lineno,
-                                "traced-branch",
-                                f"{qual}:{kind}:{'/'.join(sorted(used))}",
-                                f"Python `{kind}` on {sorted(used)} inside traced "
-                                f"function `{qual}` — a traced value here raises "
-                                "under jit or forces a host sync; use "
-                                "`jax.lax.cond`/`where` (or mark the value "
-                                "static)",
-                                severity="warning",
-                            )
+        arrayish = _arrayish_names(fn, unit)
+        for node in _body_nodes(fn):
+            if isinstance(node, ast.Call):
+                problems.extend(self._check_call(unit, qual, node, arrayish, via))
+            elif isinstance(node, (ast.If, ast.While)):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                test = node.test
+                if _test_is_exempt(test):
+                    continue
+                used = {
+                    n.id for n in ast.walk(test) if isinstance(n, ast.Name)
+                } & arrayish
+                if used:
+                    problems.append(
+                        self.finding(
+                            unit.rel,
+                            node.lineno,
+                            "traced-branch",
+                            f"{qual}:{kind}:{'/'.join(sorted(used))}",
+                            f"Python `{kind}` on {sorted(used)} inside traced "
+                            f"function `{qual}` — a traced value here raises "
+                            "under jit or forces a host sync; use "
+                            "`jax.lax.cond`/`where` (or mark the value "
+                            f"static){via}",
+                            severity="warning",
                         )
+                    )
         return problems
 
     @staticmethod
@@ -428,7 +417,7 @@ class TraceSafetyPass(AnalysisPass):
         return False
 
     def _check_call(
-        self, unit: ModuleUnit, qual: str, node: ast.Call, arrayish: Set[str]
+        self, unit: ModuleUnit, qual: str, node: ast.Call, arrayish: Set[str], via: str
     ) -> List[Finding]:
         out: List[Finding] = []
         fn = node.func
@@ -440,7 +429,7 @@ class TraceSafetyPass(AnalysisPass):
                     "host-pull",
                     f"{qual}:{fn.attr}",
                     f"`.{fn.attr}()` inside traced function `{qual}` forces a "
-                    "device->host round-trip (and raises under jit)",
+                    f"device->host round-trip (and raises under jit){via}",
                 )
             )
         elif (
@@ -462,7 +451,7 @@ class TraceSafetyPass(AnalysisPass):
                     f"`{fn.id}(...)` of a non-static value inside traced "
                     f"function `{qual}` concretizes a tracer (raises under "
                     "jit); keep the value on device or read a static "
-                    "shape/dtype instead",
+                    f"shape/dtype instead{via}",
                 )
             )
         else:
@@ -479,7 +468,7 @@ class TraceSafetyPass(AnalysisPass):
                             f"host `numpy.{tail}` inside traced function "
                             f"`{qual}` pulls a traced array to the host; use "
                             "`jax.numpy` (check the import alias) or move the "
-                            "call out of the traced region",
+                            f"call out of the traced region{via}",
                         )
                     )
         return out

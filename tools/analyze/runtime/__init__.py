@@ -1,0 +1,21 @@
+"""Runtime concurrency sanitizer: the dynamic half of the analyze engine.
+
+The static passes (lock-order, serve-blocking, trace-safety) reason about
+what the code *could* do; the passes here watch what it *actually does*.
+``witness.py`` is the instrumentation substrate — factory-level wrapping of
+``threading.Lock``/``RLock`` plus a write-recording ``Metric._state`` dict —
+and ``sanitizer.py`` registers the two dynamic passes (``lock-witness``,
+``state-race``) that drive the serve fast burst + a short soak drill under
+that instrumentation and report through the same fingerprint/baseline
+machinery as every static pass:
+
+    python -m tools.analyze --pass lock-witness --pass state-race
+
+Import-time cost here must stay stdlib-only: the engine imports this
+package to register the passes, and the ``--changed`` fast path relies on
+nothing in ``tools.analyze`` importing jax or metrics_tpu at module level.
+The serve driver lives behind a function boundary for exactly that reason.
+"""
+
+from tools.analyze.runtime import sanitizer  # noqa: F401  (register passes)
+from tools.analyze.runtime.witness import WitnessLog, witness_session  # noqa: F401

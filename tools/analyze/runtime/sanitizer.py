@@ -1,0 +1,259 @@
+"""lock-witness / state-race: the runtime concurrency sanitizer passes.
+
+Both are ``kind="dynamic"`` passes: instead of reading ASTs they install
+the :mod:`tools.analyze.runtime.witness` instrumentation and drive the
+serve fast burst plus a short soak drill in-process — producers submitting
+from multiple threads, concurrent flush/checkpoint/chaos-sync/HTTP
+traffic, then a kill→restore drill.  One drive is shared per engine run
+(cached in the context scratch), so ``--pass lock-witness --pass
+state-race`` pays the workload once.
+
+* **lock-witness** replays the static lock-order rules against what
+  actually happened: 2-cycles in the witnessed acquisition graph (rule
+  ``runtime-lock-cycle``) and acquires that parked >
+  :data:`~tools.analyze.runtime.witness.BLOCK_THRESHOLD_SECS` while the
+  thread already held a lock (rule ``runtime-blocking-while-held``).  A
+  green run over the burst is the dynamic counterexample machine for the
+  baselined static findings: the ``EvalServer.checkpoint_now -> flush ->
+  put_control`` chain runs here with every wait deadline-bounded.
+* **state-race** runs the Eraser lockset algorithm over
+  ``Metric._state`` writes: a state variable written from more than one
+  thread whose writes share no common witnessed lock is flagged (rule
+  ``unlocked-state-write``).
+
+Both self-check their coverage (rule ``witness-no-coverage``): a driver
+regression that silently stops creating locks or writing state turns the
+pass red instead of vacuously green.  Findings flow through the standard
+fingerprint/suppression/baseline path, so a witnessed-but-deliberate
+pattern is baselined with a justification exactly like a static one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from tools.analyze.engine import (
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    register_pass,
+)
+from tools.analyze.runtime.witness import WitnessLog, witness_session
+
+_SCRATCH = "runtime-witness-log"
+
+# burst knobs: sized so the whole witnessed drive stays well under the
+# tier-1 budget (<60s) while still forcing real cross-thread contention
+BURST_RECORDS = 120
+DRILL_RECORDS = 120
+DRILL_CHECKPOINT_AT = 60
+NUM_STREAMS = 8
+
+
+def drive_serve_burst() -> None:
+    """The workload both passes witness: serve fast burst + mini soak drill.
+
+    Imports live inside the function on purpose — nothing in tools.analyze
+    may import jax/metrics_tpu at module level (the ``--changed`` fast path
+    depends on it).
+    """
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from metrics_tpu.checkpoint import CheckpointManager
+    from metrics_tpu.multistream import MultiStreamMetric
+    from metrics_tpu.regression import MeanSquaredError
+    from metrics_tpu.serve import EvalServer, MetricRegistry, ServeConfig
+    from metrics_tpu.serve.soak import exercise_chaos_sync, run_drill
+
+    tmp = tempfile.mkdtemp(prefix="analyze-runtime-")
+    try:
+        registry = MetricRegistry()
+        registry.register("mse", MeanSquaredError())
+        registry.register(
+            "tenants",
+            MultiStreamMetric(MeanSquaredError(), num_streams=NUM_STREAMS),
+            export_top_k=2,
+        )
+        config = ServeConfig(block_rows=16, flush_interval=0.05)
+        manager = CheckpointManager(tmp + "/burst", rank=0, world_size=1)
+        server = EvalServer(registry, config, manager).start()
+        try:
+            rng = np.random.default_rng(11)
+
+            def produce(seed: int) -> None:
+                prng = np.random.default_rng(seed)
+                for _ in range(BURST_RECORDS):
+                    p, t = prng.uniform(size=2).astype(np.float32)
+                    server.submit("mse", (p, t), timeout=5.0)
+                    server.submit(
+                        "tenants",
+                        (p, t),
+                        stream_id=int(prng.integers(0, NUM_STREAMS)),
+                        timeout=5.0,
+                    )
+
+            producers = [
+                threading.Thread(target=produce, args=(31 + i,), name=f"producer-{i}")
+                for i in range(2)
+            ]
+            for th in producers:
+                th.start()
+            # contention against the producers: the exact surfaces the static
+            # pass baselined — flush-then-checkpoint under _ckpt_lock, the
+            # registry-wide lock sweep, an operator sync, HTTP reads
+            for path in ("/healthz", "/metrics", "/query?job=mse"):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}{path}", timeout=10.0
+                ) as resp:
+                    resp.read()
+            server.flush(timeout=10.0)
+            server.checkpoint_now()
+            exercise_chaos_sync(registry, job="mse")
+            for th in producers:
+                th.join()
+            server.flush(timeout=10.0)
+            server.checkpoint_now()
+        finally:
+            if not server._stopped:
+                server.stop()
+        # short soak drill: kill -> restore -> drain, with the poller thread
+        # issuing concurrent queries against the job locks
+        run_drill(
+            tmp + "/drill",
+            n=DRILL_RECORDS,
+            k=DRILL_CHECKPOINT_AT,
+            lost_tail=5,
+            block_rows=16,
+            num_streams=NUM_STREAMS,
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def witnessed_run(
+    workload: Optional[Callable[[], None]] = None,
+    block_threshold: Optional[float] = None,
+) -> WitnessLog:
+    """Run ``workload`` (default: the serve burst) under the witness.
+
+    The planted-fixture tests feed deliberate deadlocks/races through this
+    same entry point (with a lowered ``block_threshold``), so "the
+    sanitizer can actually see it" is a tested property, not an assumption.
+    """
+    kwargs = {} if block_threshold is None else {"block_threshold": block_threshold}
+    with witness_session(**kwargs) as log:
+        (workload or drive_serve_burst)()
+    return log
+
+
+def get_witness_log(ctx: AnalysisContext) -> WitnessLog:
+    log = ctx.scratch.get(_SCRATCH)
+    if log is None:
+        log = witnessed_run()
+        ctx.scratch[_SCRATCH] = log
+    return log
+
+
+@register_pass
+class LockWitnessPass(AnalysisPass):
+    name = "lock-witness"
+    kind = "dynamic"
+    description = (
+        "drive the serve burst + soak drill under lock instrumentation: no "
+        "cycles in the witnessed acquisition graph, nothing parked while "
+        "holding a lock"
+    )
+
+    def check_package(self, ctx: AnalysisContext) -> List[Finding]:
+        return self.findings_from_log(get_witness_log(ctx))
+
+    def findings_from_log(self, log: WitnessLog) -> List[Finding]:
+        out: List[Finding] = []
+        for a, b, (rel, lineno, thread), (rel2, lineno2, thread2) in log.cycles():
+            out.append(
+                self.finding(
+                    rel,
+                    lineno,
+                    "runtime-lock-cycle",
+                    f"{a}<->{b}",
+                    f"witnessed `{a}` held while acquiring `{b}` (thread "
+                    f"{thread}) AND `{b}` held while acquiring `{a}` "
+                    f"({rel2}:{lineno2}, thread {thread2}) — this interleaving "
+                    "deadlocks when both paths run concurrently",
+                )
+            )
+        for lock, held, secs, rel, lineno, thread in log.blocked:
+            out.append(
+                self.finding(
+                    rel,
+                    lineno,
+                    "runtime-blocking-while-held",
+                    f"{lock}:{'+'.join(held)}",
+                    f"thread {thread} parked {secs:.2f}s acquiring `{lock}` "
+                    f"while holding {list(held)} — every waiter on those locks "
+                    "stalled with it; shrink the critical section or bound "
+                    "the wait",
+                )
+            )
+        if log.locks_created == 0 or not log.edges:
+            out.append(
+                self.finding(
+                    "metrics_tpu/serve/server.py",
+                    0,
+                    "witness-no-coverage",
+                    "locks",
+                    f"the witnessed burst created {log.locks_created} "
+                    f"package lock(s) and drew {len(log.edges)} acquisition "
+                    "edge(s) — the instrumentation or the driver has rotted; "
+                    "a green run with no coverage proves nothing",
+                )
+            )
+        return out
+
+
+@register_pass
+class StateRacePass(AnalysisPass):
+    name = "state-race"
+    kind = "dynamic"
+    description = (
+        "Eraser-style lockset check over Metric._state writes during the "
+        "witnessed serve burst: no state variable is mutated from multiple "
+        "threads without a common lock"
+    )
+
+    def check_package(self, ctx: AnalysisContext) -> List[Finding]:
+        return self.findings_from_log(get_witness_log(ctx))
+
+    def findings_from_log(self, log: WitnessLog) -> List[Finding]:
+        out: List[Finding] = []
+        for otype, key, n_threads, n_writes, (rel, lineno) in log.races():
+            out.append(
+                self.finding(
+                    rel,
+                    lineno,
+                    "unlocked-state-write",
+                    f"{otype}.{key}",
+                    f"state `{otype}.{key}` written {n_writes}x from "
+                    f"{n_threads} threads with no common lock across the "
+                    "writes — torn updates are a when, not an if; route the "
+                    "writes through the owning job's lock",
+                )
+            )
+        if not log.state_writes:
+            out.append(
+                self.finding(
+                    "metrics_tpu/metric.py",
+                    0,
+                    "witness-no-coverage",
+                    "state",
+                    "the witnessed burst recorded zero Metric._state writes — "
+                    "the _make_state_dict seam or the driver has rotted; a "
+                    "green run with no coverage proves nothing",
+                )
+            )
+        return out

@@ -1,0 +1,348 @@
+"""Lock/state instrumentation for the runtime concurrency sanitizer.
+
+``witness_session()`` monkeypatches, for the duration of a ``with`` block:
+
+* ``threading.Lock`` / ``threading.RLock`` — the factories return recording
+  proxies for locks **created from package code** (the creation stack walk
+  finds a ``metrics_tpu/`` frame; everything else gets the raw primitive,
+  so jax / pytest internals stay unobserved and near-zero overhead).
+  ``queue.Queue`` built from a package frame is covered too: its internal
+  mutex is created through the patched factory, and ``Condition.wait``
+  releases/reacquires that proxy through the normal protocol, so held-sets
+  stay correct across waits.
+* ``metrics_tpu.metric._make_state_dict`` — every ``Metric._state`` dict
+  (the ``__init__`` one and the per-call swap in ``_run_with_state``)
+  becomes a subclass whose ``__setitem__`` logs (owner, key, thread,
+  currently-held witnessed locks, site).
+
+The log is pure data (:class:`WitnessLog`); turning it into findings is the
+pass's job (``sanitizer.py``), so tests can drive planted scenarios through
+the same substrate and assert on what it saw.
+
+Lock identity is the creation site (``metrics_tpu/serve/registry.py:99``)
+unless the owning code names the proxy better via the ``witness_name``
+attribute — raw primitives reject attributes, which is why the hooks in
+serve use ``try: lock.witness_name = ... except AttributeError: pass``:
+free when instrumented, a no-op in production.  Module-level locks created
+at import time predate the session and stay unobserved; the serve/sync
+surface creates all its locks per-object, which is the surface this
+sanitizer is for.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+PACKAGE_DIR = os.sep + "metrics_tpu" + os.sep
+
+# an acquire that waited longer than this while the thread already held a
+# witnessed lock is a blocking-while-held event.  The default sits above
+# worst-case jit-compile stalls (a checkpoint quiesce legitimately waits
+# ~2s for the consumer's first compile of a job update) but far below any
+# real park — fixtures drop it via ``witness_session(block_threshold=...)``
+BLOCK_THRESHOLD_SECS = 5.0
+
+
+def _package_site(skip_self: bool = True) -> Optional[Tuple[str, int]]:
+    """Nearest ``metrics_tpu/`` frame on the current stack: ``(rel, lineno)``."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        fname = frame.f_code.co_filename
+        if PACKAGE_DIR in fname:
+            rel = "metrics_tpu/" + fname.split(PACKAGE_DIR, 1)[1].replace(os.sep, "/")
+            return rel, frame.f_lineno
+        frame = frame.f_back
+    return None
+
+
+class _Held(threading.local):
+    def __init__(self) -> None:
+        self.stack: List["_WitnessedLock"] = []
+
+
+class WitnessLog:
+    """Everything one instrumented run observed.  Thread-safe via ``_mu``."""
+
+    def __init__(self, block_threshold: float = BLOCK_THRESHOLD_SECS) -> None:
+        self._mu = threading.Lock()  # created pre-patch: always a raw lock
+        self._held = _Held()
+        self.block_threshold = float(block_threshold)
+        # lock graph: (held name, acquired name) -> (rel, lineno, thread name)
+        # of the first acquisition site that drew the edge
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        # acquires that waited > threshold while holding another witnessed
+        # lock: (lock, held tuple, seconds, rel, lineno, thread)
+        self.blocked: List[Tuple[str, Tuple[str, ...], float, str, int, str]] = []
+        # Eraser state machine per state variable (dict serial, owner type,
+        # key): writes by the first thread before any other thread touches
+        # the variable are the exclusive-init phase (no lockset constraint);
+        # from the first second-thread write on, "lockset" is the running
+        # intersection of held witnessed locks across the shared writes
+        self.state_writes: Dict[Tuple[int, str, str], Dict[str, Any]] = {}
+        self.locks_created = 0
+
+    # ------------------------------------------------------------- lock side
+    def held_names(self) -> Tuple[str, ...]:
+        return tuple(lk.name for lk in self._held.stack)
+
+    def on_acquired(self, lock: "_WitnessedLock", waited: float, site: Optional[Tuple[str, int]]) -> None:
+        held = self._held.stack
+        if held:
+            rel, lineno = site if site else ("metrics_tpu", 0)
+            tname = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    if h.name != lock.name:
+                        self.edges.setdefault((h.name, lock.name), (rel, lineno, tname))
+                if waited > self.block_threshold:
+                    self.blocked.append(
+                        (lock.name, tuple(h.name for h in held), waited, rel, lineno, tname)
+                    )
+        held.append(lock)
+
+    def on_released(self, lock: "_WitnessedLock") -> None:
+        stack = self._held.stack
+        # release order can differ from acquire order: drop the last entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    # ------------------------------------------------------------ state side
+    def on_state_write(self, serial: int, owner_type: str, key: str) -> None:
+        site = _package_site()
+        locked = set(self.held_names())
+        slot = (serial, owner_type, key)
+        tid = threading.get_ident()
+        with self._mu:
+            rec = self.state_writes.get(slot)
+            if rec is None:
+                self.state_writes[slot] = {
+                    "first_thread": tid,
+                    "threads": {tid},
+                    "lockset": None,  # exclusive-init phase: unconstrained
+                    "writes": 1,
+                    "site": site or ("metrics_tpu/metric.py", 0),
+                }
+                return
+            rec["threads"].add(tid)
+            rec["writes"] += 1
+            if len(rec["threads"]) == 1:
+                return  # still exclusive to the creating thread
+            if rec["lockset"] is None:
+                rec["lockset"] = locked  # first shared write seeds the set
+            else:
+                rec["lockset"] &= locked
+
+    # ------------------------------------------------------------- analysis
+    def cycles(self) -> List[Tuple[str, str, Tuple[str, int, str], Tuple[str, int, str]]]:
+        """2-cycles in the witnessed acquisition graph (name granularity)."""
+        out = []
+        for (a, b), info in sorted(self.edges.items()):
+            if a < b and (b, a) in self.edges:
+                out.append((a, b, info, self.edges[(b, a)]))
+        return out
+
+    def races(self) -> List[Tuple[str, str, int, int, Tuple[str, int]]]:
+        """State variables whose SHARED writes (post-init, >1 thread) hold no
+        common lock: ``(owner type, key, n threads, n writes, site)``."""
+        out = []
+        seen: Set[Tuple[str, str]] = set()
+        for (_serial, otype, key), rec in sorted(
+            self.state_writes.items(), key=lambda kv: (kv[0][1], kv[0][2], kv[0][0])
+        ):
+            if (
+                len(rec["threads"]) > 1
+                and rec["lockset"] is not None
+                and not rec["lockset"]
+                and (otype, key) not in seen  # one finding per variable, not per instance
+            ):
+                seen.add((otype, key))
+                out.append((otype, key, len(rec["threads"]), rec["writes"], rec["site"]))
+        return out
+
+
+class _WitnessedLock:
+    """Recording proxy around a ``_thread.lock`` / ``RLock``.
+
+    Supports attribute assignment (``witness_name``) precisely because the
+    raw primitives do not — that asymmetry is the no-op-in-production hook.
+    """
+
+    def __init__(self, inner: Any, log: WitnessLog, site: Optional[Tuple[str, int]]) -> None:
+        self._inner = inner
+        self._log = log
+        self.created_at = site or ("metrics_tpu", 0)
+        self._name: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self._name or f"{self.created_at[0]}:{self.created_at[1]}"
+
+    @property
+    def witness_name(self) -> Optional[str]:
+        return self._name
+
+    @witness_name.setter
+    def witness_name(self, value: str) -> None:
+        self._name = str(value)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.monotonic()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._log.on_acquired(self, time.monotonic() - t0, _package_site())
+        return got
+
+    # Condition() duck-types on _release_save/_acquire_restore/_is_owned by
+    # ATTRIBUTE ACCESS (plain Lock lacks them, RLock has them), so they must
+    # raise AttributeError here exactly when the inner lock lacks them —
+    # defining them as normal methods would break Condition(plain Lock).
+    def __getattr__(self, name: str) -> Any:
+        inner = self.__dict__.get("_inner")
+        if inner is None or name.startswith("__"):
+            raise AttributeError(name)
+        inner_attr = getattr(inner, name)  # AttributeError propagates
+        if name == "_release_save":
+
+            def release_save() -> Any:
+                state = inner_attr()
+                self._log.on_released(self)
+                return state
+
+            return release_save
+        if name == "_acquire_restore":
+
+            def acquire_restore(state: Any) -> None:
+                inner_attr(state)
+                self._log.on_acquired(self, 0.0, None)
+
+            return acquire_restore
+        return inner_attr
+
+    def release(self) -> None:
+        self._inner.release()
+        self._log.on_released(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self.name} {self._inner!r}>"
+
+
+_PYTREE_REGISTERED = False
+
+
+def _ensure_pytree_registration() -> None:
+    """jax treats exact ``dict`` as a pytree but subclasses as LEAVES; state
+    dicts flow wholesale into jitted calls (``self._jitted_forward(
+    self._state, ...)``), so the recording subclass must flatten like a
+    dict.  Registration is process-global and idempotent by design — it
+    only concerns our own class."""
+    global _PYTREE_REGISTERED
+    if _PYTREE_REGISTERED:
+        return
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        _RecordingStateDict,
+        lambda d: ([d[k] for k in sorted(d)], tuple(sorted(d))),
+        lambda keys, vals: dict(zip(keys, vals)),
+    )
+    _PYTREE_REGISTERED = True
+
+
+_DICT_SERIALS = iter(range(1, 1 << 62))
+
+
+class _RecordingStateDict(dict):
+    """``Metric._state`` replacement: logs every key write with context.
+
+    Each instance carries a process-unique serial — keying the log on
+    ``id(owner)`` would alias reused ids across garbage-collected metrics,
+    and keying on the owner alone would merge the per-call scratch dicts
+    ``_run_with_state`` swaps in (which are call-local by construction)
+    with the genuinely shared persistent state dict."""
+
+    __slots__ = ("_serial", "_owner_type", "_log")
+
+    def __init__(self, owner: Any, log: WitnessLog) -> None:
+        super().__init__()
+        self._serial = next(_DICT_SERIALS)
+        self._owner_type = type(owner).__name__
+        self._log = log
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self._log.on_state_write(self._serial, self._owner_type, key)
+        super().__setitem__(key, value)
+
+    def setdefault(self, key: str, default: Any = None) -> Any:
+        if key not in self:
+            self._log.on_state_write(self._serial, self._owner_type, key)
+        return super().setdefault(key, default)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        staged = dict(*args, **kwargs)
+        for key in staged:
+            self._log.on_state_write(self._serial, self._owner_type, key)
+        super().update(staged)
+
+
+class witness_session:
+    """``with witness_session() as log:`` — patch, run, restore.
+
+    Patching is process-global, so sessions must not nest or overlap
+    (asserted).  Everything touched is restored on exit even when the
+    driven workload raises.
+    """
+
+    _active: Optional["witness_session"] = None
+
+    def __init__(self, block_threshold: float = BLOCK_THRESHOLD_SECS) -> None:
+        self.log = WitnessLog(block_threshold=block_threshold)
+
+    def __enter__(self) -> WitnessLog:
+        assert witness_session._active is None, "witness sessions cannot nest"
+        witness_session._active = self
+        log = self.log
+        self._saved_lock = threading.Lock
+        self._saved_rlock = threading.RLock
+
+        def make(factory):
+            def wrapped() -> Any:
+                inner = factory()
+                site = _package_site()
+                if site is None:
+                    return inner  # out-of-package lock: stay invisible
+                log.locks_created += 1
+                return _WitnessedLock(inner, log, site)
+
+            return wrapped
+
+        threading.Lock = make(self._saved_lock)  # type: ignore[misc]
+        threading.RLock = make(self._saved_rlock)  # type: ignore[misc]
+
+        import metrics_tpu.metric as metric_mod
+
+        _ensure_pytree_registration()
+        self._metric_mod = metric_mod
+        self._saved_factory = metric_mod._make_state_dict
+        metric_mod._make_state_dict = lambda owner: _RecordingStateDict(owner, log)
+        return log
+
+    def __exit__(self, *exc: Any) -> None:
+        threading.Lock = self._saved_lock  # type: ignore[misc]
+        threading.RLock = self._saved_rlock  # type: ignore[misc]
+        self._metric_mod._make_state_dict = self._saved_factory
+        witness_session._active = None
