@@ -764,8 +764,24 @@ def _bench_map_coco_scale(n_img=5000):
 
 
 def _bench_map_segm_scale(n_img=500, canvas=(480, 640)):
-    """Segm mAP at scale: RLE states + batched native RLE IoU/matching."""
+    """Segm mAP at scale: RLE-dict ingest + jitted device IoU/match/tables.
+
+    The headline is the COCO-realistic pipeline: ground truth and detections
+    arrive as compressed RLE strings (no dense-mask memory scan) and the
+    three protocol hot loops — segm IoU, greedy matching, score tables — run
+    as the fixed-capacity jitted kernels from ``metrics_tpu/detection/
+    device.py`` (``device=True``).  A warmup pass compiles every kernel at
+    the scale capacities; the timed window is the median of three fresh
+    update+compute passes with an obs-counter fence around it, so
+    ``timed_recompiles`` proves the capacity buckets held (any nonzero means
+    the static-shape contract broke and a timed pass re-traced).  Each
+    device stage ends in a host fetch, so the per-stage walls lifted into
+    ``stage_*_secs`` are barriered, not dispatch-only.  A dense-mask variant
+    rides along untimed-warmup-free as the bandwidth-bound reference and a
+    parity check (identical mAP to 1e-9).
+    """
     from metrics_tpu import MeanAveragePrecision
+    from metrics_tpu.obs import counters_snapshot
 
     rng = np.random.default_rng(8)
     h, w = canvas
@@ -784,37 +800,9 @@ def _bench_map_segm_scale(n_img=500, canvas=(480, 640)):
         preds.append(dict(masks=det_masks, scores=rng.random(n_d),
                           labels=np.concatenate([labels_g, rng.integers(0, 10, n_d - n_g)])[:n_d]))
         targets.append(dict(masks=gt_masks, labels=labels_g))
-    metric = MeanAveragePrecision(iou_type="segm")
-    start = time.perf_counter()
-    metric.update(preds, targets)
-    t_update = time.perf_counter() - start
-    start = time.perf_counter()
-    out = metric.compute()
-    t_compute = time.perf_counter() - start
-    prof = {k: round(v, 4) for k, v in getattr(metric, "last_compute_profile", {}).items()}
-    prof["update"] = round(t_update, 4)
-    prof["update_breakdown"] = dict(metric.last_update_profile)
-    prof["compute_total"] = round(t_compute, 4)
-    prof["map"] = round(float(out["map"]), 4)
 
-    # dense ingest is a host memory scan; record the host's own memcpy
-    # ceiling so "at the ceiling" is auditable
-    buf = np.ones(200 * 1024 * 1024, np.uint8)
-    bw = []
-    for _ in range(3):
-        start = time.perf_counter()
-        buf2 = buf.copy()
-        bw.append(2 * buf.nbytes / (time.perf_counter() - start) / 1e9)
-        del buf2
-    prof["host_memcpy_gb_per_sec"] = round(float(np.median(bw)), 2)
-    del buf
-    prof["mask_bytes_scanned_gb"] = round(
-        (sum(p["masks"].nbytes for p in preds) + sum(t["masks"].nbytes for t in targets)) / 1e9, 2
-    )
-
-    # RLE-dict ingest variant (round 5): COCO gt ships as RLE; pre-encoded
-    # inputs skip the dense scan entirely.  Encoding below is setup, not
-    # timed — it models a pipeline whose masks are already RLE.
+    # COCO gt ships as RLE; encoding below is setup, not timed — it models a
+    # pipeline whose masks are already RLE.
     from metrics_tpu.detection.mean_ap import rle_to_coco_string
     from metrics_tpu._native import rle_encode
 
@@ -830,17 +818,54 @@ def _bench_map_segm_scale(n_img=500, canvas=(480, 640)):
 
     rle_preds = to_rle(preds, ("scores", "labels"))
     rle_targets = to_rle(targets, ("labels",))
-    metric2 = MeanAveragePrecision(iou_type="segm")
+
+    def run_rle():
+        m = MeanAveragePrecision(iou_type="segm", device=True)
+        start = time.perf_counter()
+        m.update(rle_preds, rle_targets)
+        t_update = time.perf_counter() - start
+        start = time.perf_counter()
+        out = m.compute()
+        t_compute = time.perf_counter() - start
+        return t_update + t_compute, t_update, t_compute, m, out
+
+    run_rle()  # warmup: compiles every device kernel at the scale capacities
+    before = counters_snapshot()
+    runs = sorted((run_rle() for _ in range(3)), key=lambda r: r[0])
+    recompiles = sum(
+        int(v - before.get(k, 0))
+        for k, v in counters_snapshot().items()
+        if k[0] == "jit_traces" and v != before.get(k, 0)
+    )
+    t_total, t_update, t_compute, metric, out = runs[1]  # median pass
+    cprof = dict(getattr(metric, "last_compute_profile", {}))
+    prof = {k: round(v, 4) if isinstance(v, float) else v for k, v in cprof.items()}
+    uprof = dict(metric.last_update_profile)
+    prof["update"] = round(t_update, 4)
+    prof["update_breakdown"] = uprof
+    prof["compute_total"] = round(t_compute, 4)
+    prof["map"] = round(float(out["map"]), 4)
+    # flat per-stage walls (each bounded by a device->host fetch) so the
+    # next rounds can see WHICH stage moved; "map" is tables -> scalar mAP
+    prof["stage_ingest_secs"] = uprof.get("ingest_secs")
+    for stage, key in (("iou", "iou"), ("match", "match"), ("tables", "tables"), ("map", "summarize")):
+        prof[f"stage_{stage}_secs"] = round(cprof.get(key, 0.0), 4)
+    # nonzero here means a timed pass re-traced: the capacity buckets failed
+    prof["timed_recompiles"] = recompiles
+
+    # dense-mask reference: same metric config, ingest pays the full host
+    # memory scan + RLE encode; mAP must agree with the RLE path exactly
+    metric2 = MeanAveragePrecision(iou_type="segm", device=True)
     start = time.perf_counter()
-    metric2.update(rle_preds, rle_targets)
-    t_update_rle = time.perf_counter() - start
+    metric2.update(preds, targets)
+    t_update_dense = time.perf_counter() - start
     start = time.perf_counter()
     out2 = metric2.compute()
-    t_compute_rle = time.perf_counter() - start
+    t_compute_dense = time.perf_counter() - start
     assert abs(float(out2["map"]) - float(out["map"])) < 1e-9
-    prof["rle_ingest_update"] = round(t_update_rle, 4)
-    prof["rle_ingest_images_per_sec"] = round(n_img / (t_update_rle + t_compute_rle), 1)
-    return n_img / (t_update + t_compute), prof
+    prof["dense_ingest_update"] = round(t_update_dense, 4)
+    prof["dense_ingest_images_per_sec"] = round(n_img / (t_update_dense + t_compute_dense), 1)
+    return n_img / t_total, prof
 
 
 def _bench_streaming(n_batches=512, batch=8192, window=8):
@@ -1319,6 +1344,9 @@ def main() -> None:
             elif name.startswith("config5_map_segm_scale"):
                 extra[name] = round(result[0], 1)
                 extra["config5_map_segm_scale_profile"] = result[1]
+                # lift to a scalar so the compact line (which drops nested
+                # dicts) still carries the static-shape proof for config5
+                extra["config5_map_segm_scale_timed_recompiles"] = result[1]["timed_recompiles"]
             elif name.startswith("config4"):
                 extra[name] = round(result[0], 1)
                 extra["config4_breakdown"] = result[1]

@@ -1,0 +1,291 @@
+"""Jitted XLA kernels for the COCO mAP inner loops (ROADMAP item 4).
+
+The reference protocol (and the first-party C++ host kernels that replaced
+pycocotools) keeps three hot loops on the host: per-pair segm IoU over RLE
+runs, the greedy per-image matcher, and the precision/recall score tables.
+This module lowers all three to single jitted XLA programs over
+**fixed-capacity padded** operands — the same static-shape discipline the
+streaming sketches enforce (``streaming/sketches.py``: +inf-padded rows,
+compaction in trace), so a repeated compute at the same capacity bucket
+never retraces (``tools/analyze``'s shape-static pass now covers this
+directory and polices that contract).
+
+Exact-decision design
+---------------------
+``jax_enable_x64`` is off by default, so naive f32 ports would flip
+discrete decisions (a match at IoU ``0.5000001`` vs ``0.4999999``) relative
+to the float64 host reference.  Every kernel here is therefore built so the
+*discrete* outputs are bit-exact against the host pipeline and only
+*values* carry float32 rounding:
+
+* **segm IoU** returns exact int32 run-overlap counts (pixel counts fit
+  int32 for any COCO canvas); the caller divides on host in float64,
+  bit-identical to the native C++ kernel.
+* the **matcher** never sees a float: the caller rank-transforms the f64
+  IoUs (``np.unique`` + ``searchsorted`` — order isomorphic, tie-exact) and
+  the kernel runs the greedy protocol on int32 ranks.
+* the **tables** kernel compares integer TP cumsums against host-derived
+  integer recall cutoffs (``k_min``), so the 101-point interpolation picks
+  the same columns as the f64 reference; only the precision *values* are
+  f32.
+
+Padding contract (every kernel):
+
+* run tables are ``(n_masks, R)`` int32 with zero-length runs appended —
+  a zero run is an empty interval and contributes nothing;
+* rank blocks are ``(B, D, G)`` with ``-1`` marking absent det/gt slots
+  (< any threshold rank, so padding can never match);
+* code grids are ``(T, S, L)`` with an explicit validity mask.
+
+Host<->device traffic per ``compute()`` is one device_put of the padded
+operands and one fetch of the (much smaller) results; converting a result
+to numpy is the dispatch barrier, which is what the bench's per-stage
+timings measure.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from metrics_tpu.obs import core as _obs
+
+__all__ = [
+    "segm_intersections",
+    "box_inter_union",
+    "match_ranked_blocks",
+    "score_tables",
+    "bucket",
+]
+
+
+def bucket(n: int, lo: int = 8) -> int:
+    """Smallest capacity >= max(n, lo) from a fixed geometric grid.
+
+    Capacities are ``2^k`` refined by quarter-steps (``1.25/1.5/1.75 * 2^k``)
+    once above ``4*lo`` — a bounded shape set (so repeated computes at one
+    scale reuse the jit cache) that wastes at most ~25% padding instead of
+    the ~2x a pure power-of-two ladder can cost on a single-core host.
+    """
+    n = max(int(n), 1)
+    p = lo
+    while p < n:
+        p *= 2
+    if p >= 4 * lo:
+        for frac in (10, 12, 14):  # p/2 * 1.25, 1.5, 1.75
+            cand = (p * frac) // 16
+            if cand >= n:
+                return cand
+    return p
+
+
+# ---------------------------------------------------------------------------
+# segm IoU: exact run-overlap counts over padded RLE run tables
+# ---------------------------------------------------------------------------
+@jax.jit
+def _segm_inter_kernel(d_runs: jax.Array, g_runs: jax.Array, pair_d: jax.Array, pair_g: jax.Array) -> jax.Array:
+    _obs.count_trace("MeanAveragePrecision", "segm_intersections")
+    # run k of a mask occupies [bounds[k-1], bounds[k]) in column-major pixel
+    # order, zero-run first; odd runs are foreground.  Padding runs are 0, so
+    # padded bounds repeat the canvas area and span nothing.
+    d_bounds = jnp.cumsum(d_runs, axis=1, dtype=jnp.int32)
+    g_bounds = jnp.cumsum(g_runs, axis=1, dtype=jnp.int32)
+    R = d_runs.shape[1]
+    odd = (jnp.arange(R, dtype=jnp.int32) & 1) == 1
+    # fg_prefix[k] = foreground pixels in runs < k, with a leading 0 column
+    g_fgp = jnp.concatenate(
+        [jnp.zeros((g_runs.shape[0], 1), jnp.int32), jnp.cumsum(jnp.where(odd, g_runs, 0), axis=1, dtype=jnp.int32)],
+        axis=1,
+    )
+
+    def pair_inter(di, gi):
+        db = d_bounds[di]  # (R,) — evaluate gt coverage at every det boundary
+        gb = g_bounds[gi]
+        fgp = g_fgp[gi]  # (R+1,)
+        # G(x) = gt foreground pixels in [0, x): run k contains x, whole
+        # runs before it contribute fgp[k], a partial fg run the remainder
+        # (scan_unrolled: plain binary-search steps, no scan-carry overhead —
+        # measurably faster than the default on the single-core host backend)
+        k = jnp.searchsorted(gb, db, side="right", method="scan_unrolled")  # (R,)
+        prev = jnp.where(k > 0, gb[jnp.maximum(k - 1, 0)], 0)
+        partial = jnp.where((k & 1) == 1, db - prev, 0)
+        cov = fgp[k] + partial  # (R,)
+        # det fg interval j spans [db[2j], db[2j+1]); summing the per-interval
+        # coverage DIFFERENCES keeps every term in [0, canvas_area] so the
+        # int32 reduction cannot overflow (padded intervals are empty -> 0)
+        return jnp.sum(cov[1::2] - cov[0::2])
+
+    return jax.vmap(pair_inter)(pair_d, pair_g)
+
+
+def segm_intersections(
+    d_runs_pad: np.ndarray, g_runs_pad: np.ndarray, pair_d: np.ndarray, pair_g: np.ndarray
+) -> np.ndarray:
+    """Exact per-pair mask intersections (pixel counts, int32).
+
+    ``d_runs_pad``/``g_runs_pad`` are ``(n_masks, R)`` zero-padded run
+    tables; ``pair_d``/``pair_g`` index rows.  Each pair must live on one
+    image's canvas (the caller's blocks guarantee it).  Returns ``(P,)``
+    int32 intersections — divide on host in f64 for bit-parity with the
+    native kernel.
+    """
+    out = _segm_inter_kernel(
+        jnp.asarray(d_runs_pad, jnp.int32),
+        jnp.asarray(g_runs_pad, jnp.int32),
+        jnp.asarray(pair_d, jnp.int32),
+        jnp.asarray(pair_g, jnp.int32),
+    )
+    return np.asarray(out)  # numpy conversion doubles as the dispatch barrier
+
+
+# ---------------------------------------------------------------------------
+# bbox IoU: per-pair intersection/union terms
+# ---------------------------------------------------------------------------
+@jax.jit
+def _box_inter_union_kernel(dboxes: jax.Array, gboxes: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    _obs.count_trace("MeanAveragePrecision", "box_inter_union")
+    lt = jnp.maximum(dboxes[:, :2], gboxes[:, :2])
+    rb = jnp.minimum(dboxes[:, 2:], gboxes[:, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[:, 0] * wh[:, 1]
+    area_d = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
+    area_g = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+    return inter, area_d + area_g - inter
+
+
+def box_inter_union(dboxes: np.ndarray, gboxes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-pair box (intersection, union) in f32; the caller divides in f64.
+
+    Integer-coordinate boxes with areas below 2**24 stay exact in f32, so
+    the host-reference IoU reproduces bit-for-bit on such inputs; float
+    coordinates carry ~1e-7 relative rounding.
+    """
+    inter, union = _box_inter_union_kernel(
+        jnp.asarray(dboxes, jnp.float32), jnp.asarray(gboxes, jnp.float32)
+    )
+    return np.asarray(inter), np.asarray(union)
+
+
+# ---------------------------------------------------------------------------
+# greedy COCO matcher over rank-transformed IoU blocks
+# ---------------------------------------------------------------------------
+_PREF = 1 << 30  # group-priority bump; valid because ranks < 2**30
+
+
+@jax.jit
+def _match_kernel(ranks: jax.Array, gig: jax.Array, thr_ranks: jax.Array) -> jax.Array:
+    _obs.count_trace("MeanAveragePrecision", "match_ranked_blocks")
+    _, D, G = ranks.shape
+    # non-ignored gts outrank every ignored gt (absolute group priority —
+    # the host walks non-ignored-first sorted columns and breaks at the
+    # region boundary; a stable sort preserves in-group order, so argmax
+    # with last-index ties over the bumped key picks the identical gt)
+    pref = jnp.where(gig, jnp.int32(0), jnp.int32(_PREF))  # (A, B, G)
+    g_idx = jnp.arange(G, dtype=jnp.int32)
+
+    def one_block_thr(ranks_b, pref_b, thr):
+        def body(d, carry):
+            avail, codes = carry
+            r = ranks_b[d]  # (G,)
+            # padding rank -1 is below every threshold rank (>= 0)
+            elig = avail & (r >= thr)
+            key = jnp.where(elig, r + pref_b, jnp.int32(-1))
+            g_star = (G - 1) - jnp.argmax(key[::-1])  # ties -> highest index
+            matched = key[g_star] >= 0
+            code = jnp.where(
+                matched,
+                jnp.where(pref_b[g_star] == 0, jnp.uint8(2), jnp.uint8(1)),
+                jnp.uint8(0),
+            )
+            codes = codes.at[d].set(code)
+            avail = avail & ~(matched & (g_idx == g_star))
+            return avail, codes
+
+        _, codes = lax.fori_loop(
+            0, D, body, (jnp.ones(G, bool), jnp.zeros(D, jnp.uint8))
+        )
+        return codes
+
+    per_thr = jax.vmap(one_block_thr, in_axes=(None, None, 0))  # (T, D)
+    per_block = jax.vmap(per_thr, in_axes=(0, 0, None))  # (B, T, D)
+    # the area axis only changes which gts are ignored, so one dispatch
+    # covers all four COCO area ranges (ranks/thresholds broadcast)
+    return jax.vmap(per_block, in_axes=(None, 0, None))(ranks, pref, thr_ranks)  # (A, B, T, D)
+
+
+def match_ranked_blocks(ranks: np.ndarray, gt_ignore: np.ndarray, thr_ranks: np.ndarray) -> np.ndarray:
+    """Greedy COCO matching over B padded blocks, all area ranges and
+    thresholds in one pass.
+
+    ``ranks (B, D, G)`` int32 holds the rank of each det x gt IoU in the
+    epoch's sorted-unique f64 IoU table (``-1`` marks padding);
+    ``gt_ignore (A, B, G)`` the per-area-range gt ignore flags;
+    ``thr_ranks (T,)`` the rank cutoffs of the IoU thresholds.  Rank space
+    preserves every comparison and tie of the f64 protocol, so the returned
+    codes ``(A, B, T, D)`` uint8 (0 unmatched / 1 matched counted / 2
+    matched ignored) are bit-exact against the host matcher.
+    """
+    out = _match_kernel(
+        jnp.asarray(ranks, jnp.int32),
+        jnp.asarray(gt_ignore, bool),
+        jnp.asarray(thr_ranks, jnp.int32),
+    )
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# precision/recall score tables over padded per-class segments
+# ---------------------------------------------------------------------------
+@jax.jit
+def _tables_kernel(
+    codes: jax.Array, valid: jax.Array, dout: jax.Array, k_min: jax.Array, sizes: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    _obs.count_trace("MeanAveragePrecision", "score_tables")
+    L = codes.shape[-1]
+
+    def one_area(codes_a, dout_a, k_min_a):
+        tp = jnp.cumsum((codes_a == 1) & valid[None], axis=-1, dtype=jnp.int32)
+        fp = jnp.cumsum((codes_a == 0) & ~dout_a[None] & valid[None], axis=-1, dtype=jnp.int32)
+        denom = tp + fp
+        pr = jnp.where(denom > 0, tp.astype(jnp.float32) / jnp.maximum(denom, 1).astype(jnp.float32), 0.0)
+        # monotone non-increasing precision envelope
+        pr = lax.cummax(pr, axis=2, reverse=True)
+        # first column whose integer TP count reaches each recall cutoff —
+        # the same column f64 searchsorted over tp/npig picks, since k_min
+        # is the minimal integer k with f64(k/npig) >= rec_thr
+        idx = jax.vmap(jax.vmap(jnp.searchsorted, in_axes=(0, 0)), in_axes=(0, None))(tp, k_min_a)  # (T, S, R)
+        ok = idx < sizes[None, :, None]
+        prec = jnp.where(ok, jnp.take_along_axis(pr, jnp.minimum(idx, L - 1), axis=2), 0.0)
+        return jnp.transpose(prec, (0, 2, 1)), tp[:, :, L - 1]  # (T, R, S), (T, S)
+
+    # one dispatch for all four area ranges (valid/sizes are area-invariant)
+    return jax.vmap(one_area)(codes, dout, k_min)
+
+
+def score_tables(
+    codes_grid: np.ndarray,
+    valid: np.ndarray,
+    dout_grid: np.ndarray,
+    k_min: np.ndarray,
+    sizes: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-class-segment precision tables and final TP counts on device.
+
+    ``codes_grid (A, T, S, L)`` uint8 match codes laid out one class
+    segment per row in (score desc) order, ``valid (S, L)`` the padding
+    mask (shared across area ranges), ``dout_grid (A, S, L)`` out-of-area
+    flags, ``k_min (A, S, R)`` int32 minimal TP counts per recall threshold
+    (host-derived in f64), ``sizes (S,)`` actual segment lengths.  Returns
+    ``(precision (A, T, R, S) f32, tp_last (A, T, S) int32)`` — recall is
+    ``tp_last / npig`` divided on host in f64.
+    """
+    prec, tp_last = _tables_kernel(
+        jnp.asarray(codes_grid, jnp.uint8),
+        jnp.asarray(valid, bool),
+        jnp.asarray(dout_grid, bool),
+        jnp.asarray(k_min, jnp.int32),
+        jnp.asarray(sizes, jnp.int32),
+    )
+    return np.asarray(prec), np.asarray(tp_last)

@@ -1,0 +1,404 @@
+"""Golden parity: jitted device mAP kernels vs the host reference.
+
+The device lowering (``metrics_tpu/detection/device.py``) is designed so
+every *discrete* decision — which pairs intersect by how many pixels, which
+gt each det matches, which table column each recall threshold picks — is
+bit-exact against the float64 host pipeline; only precision-table VALUES
+carry f32 rounding (~1e-7).  These tests pin both halves of that contract:
+kernel-level exact equality (including planted IoU ties) and end-to-end
+``device=True`` vs ``device=False`` agreement within float tolerance on
+randomized padded inputs and the degenerate shapes (empty class, max_det=0,
+all-padding blocks, maskless images, mixed canvases).
+"""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.detection import device as dev
+from metrics_tpu.detection.mean_ap import (
+    rle_from_coco_string,
+    rle_from_coco_strings,
+    rle_to_coco_string,
+    segm_iou,
+)
+
+VALUE_TOL = 1e-6  # f32 precision-table values, averaged into mAP
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _blob_masks(rng, n, h, w):
+    out = np.zeros((n, h, w), bool)
+    for j in range(n):
+        y0 = int(rng.integers(0, max(h - 6, 1)))
+        x0 = int(rng.integers(0, max(w - 6, 1)))
+        dy = int(rng.integers(1, 14))
+        dx = int(rng.integers(1, 14))
+        out[j, y0 : min(y0 + dy, h), x0 : min(x0 + dx, w)] = True
+    return out
+
+
+def _segm_batch(rng, n_img=30, canvas=(48, 64), n_labels=4, derive_preds=True):
+    h, w = canvas
+    preds, targets = [], []
+    for _ in range(n_img):
+        n_p, n_g = int(rng.integers(0, 8)), int(rng.integers(0, 6))
+        tm = _blob_masks(rng, n_g, h, w)
+        tl = rng.integers(0, n_labels, n_g)
+        if derive_preds and n_g and n_p:
+            idx = rng.integers(0, n_g, n_p)
+            pm = np.zeros((n_p, h, w), bool)
+            for j, gi in enumerate(idx):
+                sy, sx = int(rng.integers(-3, 4)), int(rng.integers(-3, 4))
+                pm[j] = np.roll(np.roll(tm[gi], sy, axis=0), sx, axis=1)
+            pl = tl[idx]
+        else:
+            pm = _blob_masks(rng, n_p, h, w)
+            pl = rng.integers(0, n_labels, n_p)
+        preds.append(
+            dict(masks=pm, scores=rng.random(n_p).astype(np.float32), labels=pl)
+        )
+        targets.append(
+            dict(masks=tm, labels=tl, iscrowd=rng.integers(0, 2, n_g))
+        )
+    return preds, targets
+
+
+def _bbox_batch(rng, n_img=30, n_labels=4):
+    """Integer-coordinate boxes jittered off the gts: areas < 2**24 keep the
+    f32 inter/union terms exact, so bbox parity is bit-level too."""
+    preds, targets = [], []
+    for _ in range(n_img):
+        n_g = int(rng.integers(1, 6))
+        gb = np.stack(
+            [
+                rng.integers(0, 50, n_g),
+                rng.integers(0, 50, n_g),
+                rng.integers(55, 90, n_g),
+                rng.integers(55, 90, n_g),
+            ],
+            1,
+        ).astype(np.float64)
+        gl = rng.integers(0, n_labels, n_g)
+        n_p = int(rng.integers(0, 9))
+        idx = rng.integers(0, n_g, max(n_p, 1))[:n_p]
+        pb = np.clip(gb[idx] + rng.integers(-8, 9, (n_p, 4)), 0, 100)
+        preds.append(
+            dict(boxes=pb, scores=rng.random(n_p).astype(np.float32), labels=gl[idx])
+        )
+        targets.append(dict(boxes=gb, labels=gl, iscrowd=rng.integers(0, 2, n_g)))
+    return preds, targets
+
+
+def _compute_both(preds, targets, **kwargs):
+    out = {}
+    for device in (False, True):
+        m = MeanAveragePrecision(device=device, **kwargs)
+        m.update(preds, targets)
+        out[device] = {k: np.asarray(v) for k, v in m.compute().items()}
+    return out[False], out[True]
+
+
+def _assert_close(host, devr, tol=VALUE_TOL):
+    assert set(host) == set(devr)
+    for key in host:
+        h, d = host[key].astype(np.float64), devr[key].astype(np.float64)
+        assert h.shape == d.shape, key
+        if h.size:
+            diff = float(np.max(np.abs(h - d)))
+            assert diff <= tol, (key, diff)
+
+
+# ---------------------------------------------------------------------------
+# kernel level: exact decisions
+# ---------------------------------------------------------------------------
+
+
+def test_segm_intersections_exact_vs_dense():
+    rng = np.random.default_rng(0)
+    h, w = 40, 56
+    dm = _blob_masks(rng, 6, h, w)
+    gm = _blob_masks(rng, 5, h, w)
+    from metrics_tpu._native import rle_encode
+
+    d_rles = [rle_encode(m.astype(np.uint8)) for m in dm]
+    g_rles = [rle_encode(m.astype(np.uint8)) for m in gm]
+    r_cap = dev.bucket(max(len(r) for r in d_rles + g_rles), 8)
+    d_pad = np.zeros((8, r_cap), np.int32)
+    g_pad = np.zeros((8, r_cap), np.int32)
+    for i, r in enumerate(d_rles):
+        d_pad[i, : len(r)] = r
+    for i, r in enumerate(g_rles):
+        g_pad[i, : len(r)] = r
+    pd, pg = np.meshgrid(np.arange(6), np.arange(5), indexing="ij")
+    inter = dev.segm_intersections(d_pad, g_pad, pd.ravel(), pg.ravel())
+    expect = np.array(
+        [[int((a & b).sum()) for b in gm] for a in dm], np.int64
+    ).ravel()
+    assert np.array_equal(inter.astype(np.int64), expect)
+
+
+def test_segm_intersections_padding_rows_are_empty():
+    # all-padding pairs (zero-run rows) must contribute exactly zero
+    d_pad = np.zeros((4, 16), np.int32)
+    g_pad = np.zeros((4, 16), np.int32)
+    d_pad[0, :2] = [3, 5]  # 5 fg pixels on an 8-pixel canvas
+    g_pad[0, :2] = [0, 8]  # all-fg mask
+    pairs_d = np.array([0, 1, 2, 3], np.int32)
+    pairs_g = np.array([0, 1, 2, 3], np.int32)
+    inter = dev.segm_intersections(d_pad, g_pad, pairs_d, pairs_g)
+    assert inter[0] == 5
+    assert np.array_equal(inter[1:], np.zeros(3, np.int32))
+
+
+def test_match_kernel_exact_with_planted_ties():
+    # two dets tie on IoU rank for one gt, plus an ignored-gt group: the
+    # greedy protocol must pick the SAME gt as the host matcher (last index
+    # among maxima, non-ignored group first)
+    rng = np.random.default_rng(1)
+    B, D, G, T = 5, 4, 3, 3
+    ious = rng.integers(0, 4, (B, D, G)).astype(np.float64) / 4.0
+    ious[0, 0, :] = [0.5, 0.5, 0.5]  # planted three-way tie
+    ious[0, 1, :] = [0.5, 0.75, 0.75]  # planted two-way tie
+    gig = np.zeros((2, B, G), bool)
+    gig[1] = rng.random((B, G)) < 0.5
+    u = np.unique(ious)
+    ranks = np.searchsorted(u, ious).astype(np.int32)
+    thr = np.minimum(np.array([0.25, 0.5, 0.75]), 1 - 1e-10)
+    thr_ranks = np.searchsorted(u, thr, side="left").astype(np.int32)
+    codes = dev.match_ranked_blocks(ranks, gig, thr_ranks)
+    assert codes.shape == (2, B, T, D)
+
+    # host-protocol reference, straight off the published pycocotools walk
+    def host_match(iou_b, gig_b, t):
+        avail = np.ones(G, bool)
+        codes_b = np.zeros(D, np.uint8)
+        order = np.argsort(~gig_b, kind="stable")  # non-ignored FIRST after flip
+        order = order[np.argsort(gig_b[order], kind="stable")]
+        for d in range(D):
+            best, best_iou = -1, t
+            for g in order:  # non-ignored first, original order within group
+                if not avail[g]:
+                    continue
+                if best >= 0 and not gig_b[best] and gig_b[g]:
+                    break  # crossing into the ignored region with a match
+                if iou_b[d, g] >= best_iou:
+                    best, best_iou = g, iou_b[d, g]
+            if best >= 0:
+                avail[best] = False
+                codes_b[d] = 2 if gig_b[best] else 1
+        return codes_b
+
+    for a in range(2):
+        for b in range(B):
+            for ti, t in enumerate([0.25, 0.5, 0.75]):
+                expect = host_match(ious[b], gig[a, b], t)
+                assert np.array_equal(codes[a, b, ti], expect), (a, b, ti)
+
+
+def test_match_kernel_all_padding_block():
+    ranks = np.full((2, 3, 4), -1, np.int32)  # every slot absent
+    gig = np.zeros((4, 2, 4), bool)
+    thr_ranks = np.zeros(3, np.int32)
+    codes = dev.match_ranked_blocks(ranks, gig, thr_ranks)
+    assert codes.shape == (4, 2, 3, 3)
+    assert not codes.any()  # padding can never match
+
+
+def test_score_tables_matches_host_reference():
+    rng = np.random.default_rng(2)
+    T, S, L, R, A = 3, 4, 12, 5, 2
+    sizes = rng.integers(1, L + 1, S).astype(np.int64)
+    valid = np.zeros((S, L), bool)
+    for s in range(S):
+        valid[s, : sizes[s]] = True
+    codes = (rng.integers(0, 3, (A, T, S, L)) * valid[None, None]).astype(np.uint8)
+    dout = (rng.random((A, S, L)) < 0.3) & valid[None]
+    npig = rng.integers(1, 9, (A, S)).astype(np.float64)
+    rec_thrs = np.linspace(0.0, 1.0, R)
+    kmin = np.zeros((A, S, R), np.int32)
+    for a in range(A):
+        kmin[a] = MeanAveragePrecision._recall_kmin(npig[a], rec_thrs)
+    prec, tp_last = dev.score_tables(codes, valid, dout, kmin, sizes.astype(np.int32))
+    for a in range(A):
+        for t in range(T):
+            for s in range(S):
+                c = codes[a, t, s, : sizes[s]].astype(np.int64)
+                o = dout[a, s, : sizes[s]]
+                tp = np.cumsum(c == 1)
+                fp = np.cumsum((c == 0) & ~o)
+                assert tp_last[a, t, s] == tp[-1]
+                rc = tp / npig[a, s]
+                pr = tp / np.maximum(tp + fp, 1e-12)
+                for i in range(len(pr) - 1, 0, -1):  # monotone envelope
+                    pr[i - 1] = max(pr[i - 1], pr[i])
+                inds = np.searchsorted(rc, rec_thrs, side="left")
+                expect = np.zeros(R)
+                ok = inds < len(pr)
+                expect[ok] = pr[inds[ok]]
+                np.testing.assert_allclose(prec[a, t, :, s], expect, atol=1e-6)
+
+
+def test_bucket_ladder_properties():
+    for n in (1, 7, 8, 9, 31, 32, 33, 100, 194, 1000, 4085, 8200, 10000):
+        cap = dev.bucket(n)
+        assert cap >= n
+        assert cap <= 2 * max(n, 8)
+    # quarter-step refinement caps the padding waste well below 2x
+    assert dev.bucket(10000) == 10240
+    assert dev.bucket(194, 64) == 224
+    # determinism: equal inputs always map to the same capacity (jit cache)
+    assert dev.bucket(4085) == dev.bucket(4085)
+
+
+# ---------------------------------------------------------------------------
+# end to end: device=True vs device=False
+# ---------------------------------------------------------------------------
+
+
+def test_segm_end_to_end_parity_randomized():
+    rng = np.random.default_rng(10)
+    preds, targets = _segm_batch(rng)
+    host, devr = _compute_both(preds, targets, iou_type="segm")
+    _assert_close(host, devr)
+    assert float(devr["map"]) > 0  # the fixture must actually exercise matches
+
+
+def test_bbox_end_to_end_parity_integer_boxes():
+    rng = np.random.default_rng(11)
+    preds, targets = _bbox_batch(rng)
+    host, devr = _compute_both(preds, targets, iou_type="bbox")
+    _assert_close(host, devr)
+    assert float(devr["map"]) > 0
+
+
+def test_parity_with_empty_classes_and_images():
+    rng = np.random.default_rng(12)
+    preds, targets = _segm_batch(rng, n_img=12, derive_preds=False)
+    # plant: a class present only in gts, a class present only in preds,
+    # detection-free images, gt-free images (already randomized in), and a
+    # fully empty image pair
+    h, w = 48, 64
+    preds.append(dict(masks=np.zeros((0, h, w), bool), scores=np.zeros(0), labels=np.zeros(0, np.int64)))
+    targets.append(dict(masks=_blob_masks(rng, 2, h, w), labels=np.array([7, 7])))
+    preds.append(dict(masks=_blob_masks(rng, 2, h, w), scores=rng.random(2), labels=np.array([9, 9])))
+    targets.append(dict(masks=np.zeros((0, h, w), bool), labels=np.zeros(0, np.int64)))
+    preds.append(dict(masks=np.zeros((0, h, w), bool), scores=np.zeros(0), labels=np.zeros(0, np.int64)))
+    targets.append(dict(masks=np.zeros((0, h, w), bool), labels=np.zeros(0, np.int64)))
+    host, devr = _compute_both(preds, targets, iou_type="segm")
+    _assert_close(host, devr)
+
+
+def test_parity_max_det_zero():
+    rng = np.random.default_rng(13)
+    preds, targets = _segm_batch(rng, n_img=8)
+    host, devr = _compute_both(
+        preds, targets, iou_type="segm", max_detection_thresholds=[0, 1, 10]
+    )
+    _assert_close(host, devr)
+
+
+def test_parity_mixed_canvases():
+    rng = np.random.default_rng(14)
+    p1, t1 = _segm_batch(rng, n_img=6, canvas=(32, 40))
+    p2, t2 = _segm_batch(rng, n_img=6, canvas=(56, 24))
+    host, devr = _compute_both(p1 + p2, t1 + t2, iou_type="segm")
+    _assert_close(host, devr)
+
+
+def test_device_flag_validation_and_profile():
+    with pytest.raises(ValueError):
+        MeanAveragePrecision(device="yes")
+    m = MeanAveragePrecision(iou_type="segm", device=True)
+    rng = np.random.default_rng(15)
+    preds, targets = _segm_batch(rng, n_img=4)
+    m.update(preds, targets)
+    m.compute()
+    assert m.last_compute_profile["device"] is True
+    m2 = MeanAveragePrecision(iou_type="segm", device=False)
+    m2.update(preds, targets)
+    m2.compute()
+    assert m2.last_compute_profile["device"] is False
+
+
+def test_device_compute_is_recompile_stable():
+    """Two computes at the same scale must not re-trace any kernel (the
+    capacity buckets are the static-shape contract device-side)."""
+    from metrics_tpu.obs import counters_snapshot
+
+    rng = np.random.default_rng(16)
+    preds, targets = _segm_batch(rng, n_img=10)
+    m = MeanAveragePrecision(iou_type="segm", device=True)
+    m.update(preds, targets)
+    m.compute()  # warm: compiles at these buckets
+    before = counters_snapshot()
+    m2 = MeanAveragePrecision(iou_type="segm", device=True)
+    # a fresh metric over the same inputs pads to the same capacity
+    # buckets, so the warm jit cache must serve every kernel
+    m2.update(preds, targets)
+    m2.compute()
+    delta = sum(
+        int(v - before.get(k, 0))
+        for k, v in counters_snapshot().items()
+        if k[0] == "jit_traces"
+    )
+    assert delta == 0
+
+
+# ---------------------------------------------------------------------------
+# heavy randomized sweeps (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_segm_parity_sweep(seed):
+    rng = np.random.default_rng(100 + seed)
+    n_img = int(rng.integers(5, 60))
+    canvas = (int(rng.integers(16, 96)), int(rng.integers(16, 96)))
+    preds, targets = _segm_batch(
+        rng, n_img=n_img, canvas=canvas, n_labels=int(rng.integers(1, 8)),
+        derive_preds=bool(rng.integers(0, 2)),
+    )
+    host, devr = _compute_both(preds, targets, iou_type="segm")
+    _assert_close(host, devr)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_bbox_parity_sweep(seed):
+    rng = np.random.default_rng(200 + seed)
+    preds, targets = _bbox_batch(rng, n_img=int(rng.integers(5, 60)))
+    host, devr = _compute_both(preds, targets, iou_type="bbox")
+    _assert_close(host, devr)
+
+
+@pytest.mark.slow
+def test_segm_parity_rle_string_ingest_roundtrip():
+    """Device parity must hold when masks arrive pre-encoded as COCO RLE
+    strings (the bench's headline ingest path)."""
+    from metrics_tpu._native import rle_encode
+
+    rng = np.random.default_rng(300)
+    preds, targets = _segm_batch(rng, n_img=20)
+
+    def to_rle(batch, keep):
+        out = []
+        for d in batch:
+            dicts = [
+                {"size": list(m.shape), "counts": rle_to_coco_string(rle_encode(m.astype(np.uint8)))}
+                for m in d["masks"]
+            ]
+            out.append({**{k: d[k] for k in keep}, "masks": dicts})
+        return out
+
+    rle_preds = to_rle(preds, ("scores", "labels"))
+    rle_targets = to_rle(targets, ("labels", "iscrowd"))
+    host, devr = _compute_both(rle_preds, rle_targets, iou_type="segm")
+    _assert_close(host, devr)
+    dense_host, _ = _compute_both(preds, targets, iou_type="segm")
+    _assert_close(dense_host, host, tol=0.0)  # ingest path changes nothing

@@ -91,6 +91,35 @@ def test_pass_metadata(name):
 
 
 # ---------------------------------------------------------------------------
+# allowlist pins: the eager escape hatches must not silently regrow
+# ---------------------------------------------------------------------------
+
+
+def test_trace_safety_allowlist_is_pinned():
+    from tools.analyze.passes.trace_safety import EAGER_ALLOWLIST
+
+    # exact pin: adding an entry here is a reviewed decision, not a drive-by
+    # (the detection package came OFF the list when its mAP inner loops were
+    # jitted — only the host orchestration/IO module remains)
+    assert set(EAGER_ALLOWLIST) == {
+        "metrics_tpu/detection/mean_ap.py",
+        "metrics_tpu/_native/",
+        "metrics_tpu/serve/httpd.py",
+        "metrics_tpu/serve/soak.py",
+        "metrics_tpu/serve/traffic.py",
+    }
+    # whole-directory entries are reserved for host/FFI boundaries; the
+    # jitted detection kernels (detection/device.py) stay under coverage
+    assert not any(entry == "metrics_tpu/detection/" for entry in EAGER_ALLOWLIST)
+
+
+def test_shape_static_scope_covers_detection():
+    from tools.analyze.passes.shape_static import SCOPE_PREFIXES
+
+    assert "metrics_tpu/detection/" in SCOPE_PREFIXES
+
+
+# ---------------------------------------------------------------------------
 # fixtures: exact finding counts per pass
 # ---------------------------------------------------------------------------
 

@@ -37,6 +37,9 @@ SCOPE_PREFIXES = (
     # the serving path dispatches compiled blocks: the same static-shape
     # discipline applies to everything between the queue and the metric
     "metrics_tpu/serve/",
+    # the detection device kernels run over fixed-capacity padded operands;
+    # the host-orchestration module opts out with a skip-file marker
+    "metrics_tpu/detection/",
 )
 
 # call names whose result shape depends on data values

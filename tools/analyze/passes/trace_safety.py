@@ -105,7 +105,9 @@ SCALAR_ANNOTATIONS = {"int", "bool", "str", "bytes"}
 # deliberately-eager module prefixes: host kernels and serve/O — the paths
 # that are eager BY DESIGN (finer-grained opt-outs use skip-file markers)
 EAGER_ALLOWLIST = (
-    "metrics_tpu/detection/",  # COCO matching runs as host kernels (numpy/ctypes)
+    # mean_ap.py is the host orchestration/IO layer ONLY — the jitted mAP
+    # kernels live in detection/device.py, which must stay off this list
+    "metrics_tpu/detection/mean_ap.py",
     "metrics_tpu/_native/",  # ctypes build + host shims
     "metrics_tpu/serve/httpd.py",  # HTTP I/O is host-side by definition
     "metrics_tpu/serve/soak.py",  # soak harness drives the server eagerly
