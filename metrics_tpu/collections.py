@@ -543,6 +543,32 @@ class MetricCollection:
         for m in self._modules.values():
             m.persistent(mode)
 
+    def shard(
+        self,
+        mesh: Optional[Any] = None,
+        axis_name: str = "batch",
+        install_backend: bool = True,
+    ) -> "MetricCollection":
+        """Place every member's state on a device mesh (see :meth:`Metric.shard`).
+
+        Placement runs per member, so each records its own ``_placement`` and
+        re-pins after reset/restore; compute-group members are then re-aliased
+        to their (now mesh-placed) leader arrays so state sharing survives the
+        move.  With ``install_backend`` every member syncs through its own
+        :class:`~metrics_tpu.parallel.MeshBackend` over ``axis_name``.
+        """
+        from metrics_tpu.parallel.mesh import default_mesh
+
+        mesh = mesh if mesh is not None else default_mesh(axis_name=axis_name)
+        for m in self._modules.values():
+            m.shard(mesh, axis_name=axis_name, install_backend=install_backend)
+        if self._groups_checked:
+            self._share_group_states()
+        return self
+
+    #: alias: the placement verb used by the single-metric API
+    place = shard
+
     def state_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for name, m in self._modules.items():
@@ -623,6 +649,7 @@ class MetricCollection:
             "bytes_saved": 0,
             "delta_syncs": 0,
             "full_syncs": 0,
+            "in_xla_reductions": 0,
             "backoff_secs": 0.0,
             "errors": [],
         }
@@ -637,7 +664,14 @@ class MetricCollection:
             totals["backoff_secs"] = round(
                 totals["backoff_secs"] + float(rep.get("backoff_secs") or 0.0), 6
             )
-            for key in ("retries", "attempts", "gather_calls", "bytes_gathered", "bytes_saved"):
+            for key in (
+                "retries",
+                "attempts",
+                "gather_calls",
+                "bytes_gathered",
+                "bytes_saved",
+                "in_xla_reductions",
+            ):
                 totals[key] += int(rep.get(key) or 0)
             if "delta" in rep:
                 totals["delta_syncs" if rep["delta"] else "full_syncs"] += 1

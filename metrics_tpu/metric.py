@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, PartitionSpec
 
 from metrics_tpu.obs import core as _obs
 from metrics_tpu.parallel.backend import Backend, SyncOptions, get_backend, reduce_synced_state
@@ -305,6 +306,12 @@ class Metric(ABC):
         self._defaults: Dict[str, Any] = {}
         self._reduce_fns: Dict[str, Any] = {}
         self._persistent: Dict[str, bool] = {}
+        # per-state PartitionSpec overrides (add_state(spec=...)); states
+        # without an entry fall back to the kind-based default at placement
+        # time (replicated scalars, row-sharded cat/list/buffer rows)
+        self._specs: Dict[str, Optional[PartitionSpec]] = {}
+        # (mesh, axis_name) once shard()/place() ran; restores re-pin onto it
+        self._placement: Optional[Tuple[Mesh, str]] = None
         # capacity-bounded buffer states (SURVEY §7 delta 2(b)):
         # name -> {count, capacity, alloc_cap, trail, dtype}
         self._buffer_states: Dict[str, Dict[str, Any]] = {}
@@ -396,17 +403,37 @@ class Metric(ABC):
         default: Any,
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        spec: Optional[PartitionSpec] = None,
     ) -> None:
         """Register a streaming state (reference ``metric.py:150-217``).
 
         ``default`` is either an array (tensor state, fixed shape) or an empty
         Python list (list state, gathered with ``cat`` semantics).
+
+        ``spec`` is an optional :class:`jax.sharding.PartitionSpec` consumed
+        by :meth:`shard`: where this state's leaves live on the device mesh.
+        Reduced states (``sum``/``mean``/``max``/``min``) must replicate —
+        every device holds the full reduced value, so a sharded spec is a
+        contract error (the ``state-contract`` analyzer pass flags it
+        statically too).  ``cat``/list/buffer states default to row-sharding
+        (``P('batch')``) and may override it here.
         """
         if isinstance(dist_reduce_fx, str):
             if dist_reduce_fx not in _ALLOWED_REDUCE:
                 raise ValueError(f"`dist_reduce_fx` must be one of {_ALLOWED_REDUCE}, callable or None")
         elif dist_reduce_fx is not None and not callable(dist_reduce_fx):
             raise ValueError("`dist_reduce_fx` must be a str, callable or None")
+        if spec is not None:
+            if not isinstance(spec, PartitionSpec):
+                raise ValueError(f"`spec` must be a jax.sharding.PartitionSpec, got {type(spec).__name__}")
+            if any(ax is not None for ax in tuple(spec)) and dist_reduce_fx in (
+                "sum", "mean", "max", "min",
+            ):
+                raise ValueError(
+                    f"state {name!r}: a sharded spec={spec} contradicts "
+                    f"dist_reduce_fx={dist_reduce_fx!r} — reduced states hold the "
+                    "full value on every device and must replicate (P())"
+                )
         if isinstance(default, list):
             if default:
                 raise ValueError("list states must default to the empty list")
@@ -424,6 +451,7 @@ class Metric(ABC):
         self._defaults[name] = default
         self._reduce_fns[name] = dist_reduce_fx
         self._persistent[name] = persistent
+        self._specs[name] = spec
         # live state must not alias the stored default: the jitted update
         # donates state buffers, and a donated default would poison every
         # future reset()
@@ -920,6 +948,9 @@ class Metric(ABC):
         self._computed = None
         # merged-in rows were never part of a gathered prefix
         self._delta_cache.clear()
+        # elastic restore path: merged leaves are host concatenations — put
+        # them back on the recorded mesh placement (sync.resharded_states)
+        self._reshard_after_restore()
 
     def _sync_state_pure(
         self,
@@ -979,6 +1010,16 @@ class Metric(ABC):
                     if isinstance(value, list):
                         if not value:
                             out[name] = value
+                            continue
+                        gather_list = getattr(backend, "all_gather_list", None)
+                        if gather_list is not None and not any(
+                            isinstance(v, jax.core.Tracer) for v in value
+                        ):
+                            # in-program backends (single-controller): the local
+                            # rows already ARE the global rows, so the gather is
+                            # deferred to the point of consumption instead of
+                            # re-materializing O(total) rows on every sync
+                            out[name] = gather_list(value)
                             continue
                         value = jnp.atleast_1d(dim_zero_cat(value))
                         if name in delta_plan:
@@ -2185,7 +2226,11 @@ class Metric(ABC):
                 return
             report: Dict[str, Any] = {
                 "backend": type(backend).__name__,
-                "world_size": int(backend.world_size()) if backend.eager else None,
+                # in-trace backends have no host-known size, EXCEPT the mesh
+                # backend whose world is the static mesh extent
+                "world_size": int(backend.world_size())
+                if backend.eager or getattr(backend, "in_xla", False)
+                else None,
                 "fallback": None,
                 "error": None,
             }
@@ -2362,6 +2407,10 @@ class Metric(ABC):
                 # from epoch to epoch, bounded memory in between
                 cap = max(meta["alloc_cap"], meta["capacity"], 1)
                 self._state[bname + "__buf"] = jnp.zeros((cap,) + meta["trail"], meta["dtype"])
+        if self._placement is not None:
+            # fresh default arrays are host/device-0 allocations; keep the
+            # epoch-to-epoch placement stable so jitted traces don't churn
+            self._place_state_leaves(*self._placement)
 
     def clone(self) -> "Metric":
         return copy.deepcopy(self)
@@ -2374,6 +2423,100 @@ class Metric(ABC):
             elif not isinstance(value, (int, tuple)):  # buffer counts stay host-side
                 self._state[name] = jax.device_put(value, device)
         return self
+
+    # ------------------------------------------------------- mesh placement
+    def _state_spec(self, name: str, axis_name: str) -> Optional[PartitionSpec]:
+        """The effective ``PartitionSpec`` for one flat state key.
+
+        Explicit ``add_state(spec=...)`` wins; otherwise the kind decides:
+        row states (cat/list tensors, buffer rows) shard their leading axis
+        over the mesh (``P(axis)``), everything reduced or fixed-shape
+        (scalars, sketch leaves, buffer counts) replicates (``None``).
+        """
+        explicit = self._specs.get(name)
+        if explicit is not None:
+            return explicit
+        if name.endswith("__len"):
+            return None
+        for sname in self._sketch_states:
+            if name in self._sketch_leaf_keys(sname):
+                return None
+        if name.endswith("__buf"):
+            return PartitionSpec(axis_name)
+        fx = self._reduce_fns.get(name)
+        if fx == "cat" or (fx is None and isinstance(self._defaults.get(name), list)):
+            return PartitionSpec(axis_name)
+        return None
+
+    def _place_state_leaves(self, mesh: Mesh, axis_name: str) -> int:
+        """``device_put`` every array state leaf onto ``mesh`` per its spec.
+
+        Returns the number of leaves placed.  Python-int buffer counts and
+        (still-unconcatenated) list entries are skipped — lists are placed
+        when sync/cat materializes their rows.
+        """
+        from metrics_tpu.parallel.mesh import leaf_sharding
+
+        placed = 0
+        for name, value in self._state.items():
+            if isinstance(value, (list, int, tuple)):
+                continue
+            spec = self._state_spec(name, axis_name)
+            sharding = leaf_sharding(mesh, value, spec, axis_name)
+            if getattr(value, "sharding", None) != sharding:
+                self._state[name] = jax.device_put(value, sharding)
+            placed += 1
+        return placed
+
+    def shard(
+        self,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "batch",
+        install_backend: bool = True,
+    ) -> "Metric":
+        """Place every state leaf on a device mesh with ``NamedSharding``.
+
+        Mirrors ``multistream/sharding.py``'s ``shard_streams`` seam for the
+        single-metric case: reduced states replicate, row states shard
+        ``P(axis_name)``, and (unless ``install_backend=False``) subsequent
+        syncs run through :class:`~metrics_tpu.parallel.MeshBackend` — in-XLA
+        reductions, no host gather, ``compute()`` never leaves the device.
+
+        Placement survives :meth:`reset` and is re-applied after checkpoint
+        restore / elastic merge (counted as ``sync.resharded_states``); it
+        does NOT survive pickling — re-shard a deserialized metric.
+        """
+        from metrics_tpu.parallel.mesh import MeshBackend, default_mesh
+
+        self._flush_pending()
+        self._flush_host_buffers()
+        mesh = mesh if mesh is not None else default_mesh(axis_name=axis_name)
+        if axis_name not in mesh.shape:
+            raise ValueError(
+                f"axis {axis_name!r} is not an axis of the mesh (axes: {tuple(mesh.shape)})"
+            )
+        self._placement = (mesh, axis_name)
+        placed = self._place_state_leaves(mesh, axis_name)
+        if install_backend:
+            self.sync_backend = MeshBackend(mesh, axis_name=axis_name, options=self._sync_options())
+        _obs.counter_inc("sync.mesh_placements", placed, metric=type(self).__name__)
+        return self
+
+    #: alias: the ISSUE/ROADMAP name for the same placement seam
+    place = shard
+
+    def _reshard_after_restore(self) -> None:
+        """Re-pin restored/merged leaves onto the recorded mesh placement.
+
+        Checkpoint restore and elastic merge materialize host arrays; when a
+        placement is active they are put back where they lived, counted as
+        ``sync.resharded_states``.
+        """
+        if self._placement is None:
+            return
+        mesh, axis_name = self._placement
+        placed = self._place_state_leaves(mesh, axis_name)
+        _obs.counter_inc("sync.resharded_states", placed, metric=type(self).__name__)
 
     def set_dtype(self, dst_type: Any) -> "Metric":
         """Cast floating states (reference ``metric.py:588-614``)."""
@@ -2440,6 +2583,7 @@ class Metric(ABC):
         for bname in self._buffer_states:
             if bname + "__buf" in state_dict:
                 self._refresh_buffer_meta(bname)
+        self._reshard_after_restore()
 
     # python attributes determined at runtime from the data (e.g. the
     # classification input `mode` locked on the first update) that a
@@ -2587,6 +2731,7 @@ class Metric(ABC):
         for bname in self._buffer_states:
             if bname + "__buf" in self._state:
                 self._refresh_buffer_meta(bname)
+        self._reshard_after_restore()
 
     # ------------------------------------------------------------- pickling
     def __getstate__(self) -> Dict[str, Any]:
@@ -2619,6 +2764,11 @@ class Metric(ABC):
         # from one full gather
         d["_delta_cache"] = None
         d["_last_synced_state"] = None
+        # a Mesh holds live Device handles — neither the placement record nor
+        # a mesh-holding backend crosses pickling; re-shard() after restore
+        d["_placement"] = None
+        if getattr(d.get("sync_backend"), "mesh", None) is not None:
+            d["sync_backend"] = None
         return d
 
     def __setstate__(self, d: Dict[str, Any]) -> None:
@@ -2637,6 +2787,8 @@ class Metric(ABC):
         d.setdefault("sync_report_history", deque(maxlen=16))
         d.setdefault("delta_sync", True)
         d.setdefault("_last_synced_state", None)
+        d.setdefault("_specs", {})
+        d.setdefault("_placement", None)
         if d.get("_delta_cache") is None:
             d["_delta_cache"] = _DeltaCache()
         self.__dict__.update(d)

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec
 
 from metrics_tpu.metric import Metric, _flatten_batched_inputs
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
@@ -541,6 +542,20 @@ class MultiStreamMetric(Metric):
         return int(np.asarray(self._state[self._DROPPED_STATE]))
 
     # ------------------------------------------------------------------- misc
+    def _state_spec(self, name: str, axis_name: str) -> Optional[PartitionSpec]:
+        """Per-axis placement: every stacked ``(num_streams, ...)`` leaf —
+        tensor or sketch — shards its stream axis over the mesh; the scalar
+        dropped counter (and anything else without a stream axis) falls back
+        to the base rules.  Explicit ``add_state(spec=...)`` still wins."""
+        explicit = self._specs.get(name)
+        if explicit is not None:
+            return explicit
+        value = self._state.get(name)
+        shape = tuple(getattr(value, "shape", ()))
+        if shape and shape[0] == self.num_streams:
+            return PartitionSpec(axis_name)
+        return super()._state_spec(name, axis_name)
+
     def _finish_sync_report(self, report: Dict[str, Any], backend: Any, start: float) -> None:
         super()._finish_sync_report(report, backend, start)
         gathered = int(report.get("bytes_gathered") or 0)

@@ -52,21 +52,19 @@ def shard_streams(
     jitted updates/computes follow the placement, so per-stream work runs
     where its shard lives.  ``num_streams`` must divide the mesh size.
 
+    Delegates to :meth:`Metric.shard` (the unified placement seam), so the
+    placement is recorded and re-applied after ``reset`` and checkpoint
+    restore, counted as ``sync.mesh_placements``/``sync.resharded_states``.
+    No sync backend is installed — multistream sync rides the per-axis
+    reduce seams of whatever backend the metric already has.
+
     Returns the metric (placement happens in place).
     """
     mesh = mesh if mesh is not None else stream_mesh(axis_name=axis_name)
-    split = stream_sharding(mesh, axis_name)
-    replicate = replicate_sharding(mesh, axis_name)
     n_dev = mesh.devices.size
     if metric.num_streams % n_dev:
         raise ValueError(
             f"num_streams={metric.num_streams} must divide evenly over the "
             f"{n_dev}-device mesh"
         )
-    metric._flush_pending()
-    for name, value in metric._state.items():
-        if not hasattr(value, "ndim"):
-            continue
-        is_stacked = value.ndim >= 1 and value.shape[0] == metric.num_streams
-        metric._state[name] = jax.device_put(value, split if is_stacked else replicate)
-    return metric
+    return metric.shard(mesh, axis_name=axis_name, install_backend=False)

@@ -203,7 +203,19 @@ def spans_snapshot() -> Dict[CounterKey, List[float]]:
 # ---------------------------------------------------------------------------
 # sync-report registry (absorbs Metric.last_sync_report; always on)
 
-_SYNC_COUNTER_KEYS = ("bytes_gathered", "gather_calls", "retries", "attempts", "bytes_saved")
+_SYNC_COUNTER_KEYS = (
+    "bytes_gathered",
+    "gather_calls",
+    "retries",
+    "attempts",
+    "bytes_saved",
+    # preflight metadata traffic is accounted apart from state payload so
+    # `bytes_gathered` means the same thing on every eager backend
+    "preflight_bytes",
+    "preflight_calls",
+    # the mesh backend's in-program path has no wire bytes to count
+    "in_xla_reductions",
+)
 
 
 def record_sync_report(metric: str, report: Dict[str, Any]) -> None:

@@ -16,6 +16,7 @@ from metrics_tpu.parallel.backend import (
     schema_digest_rows,
 )
 from metrics_tpu.parallel.faults import ChaosBackend, ChaosInjectedError, ChaosInjectedSyncError
+from metrics_tpu.parallel.mesh import MeshBackend, default_mesh, leaf_sharding
 
 __all__ = [
     "AxisBackend",
@@ -24,14 +25,17 @@ __all__ = [
     "ChaosInjectedError",
     "ChaosInjectedSyncError",
     "LoopbackBackend",
+    "MeshBackend",
     "MultihostBackend",
     "NullBackend",
     "SyncOptions",
     "axis_context",
     "current_axis",
+    "default_mesh",
     "find_schema_divergence",
     "get_backend",
     "guarded_collective",
+    "leaf_sharding",
     "reduce_synced_state",
     "schema_digest_rows",
 ]

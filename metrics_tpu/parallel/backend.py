@@ -515,18 +515,25 @@ class MultihostBackend(Backend):
         from jax.experimental import multihost_utils
 
         cls = MultihostBackend
+        out: Any = None
         if cls._xla_collectives_broken is None:
             try:
                 out = multihost_utils.process_allgather(x)
                 cls._xla_collectives_broken = False
-                return out
             except Exception as err:  # jaxlib raises a plain XlaRuntimeError
                 if "Multiprocess computations aren't implemented" not in str(err):
                     raise
                 cls._xla_collectives_broken = True
-        if cls._xla_collectives_broken:
-            return self._kv_allgather(x, seq)
-        return multihost_utils.process_allgather(x)
+        if out is None:
+            if cls._xla_collectives_broken:
+                out = self._kv_allgather(x, seq)
+            else:
+                out = multihost_utils.process_allgather(x)
+        # world-1 jobs: process_allgather returns the input unchanged, but
+        # every caller relies on the (P,) + x.shape contract
+        if self.world_size() == 1 and np.shape(out) == np.shape(x):
+            out = np.asarray(out)[None]
+        return out
 
     def _kv_allgather(self, x: Array, seq: int) -> Any:
         """Cross-process gather over the ``jax.distributed`` coordination
@@ -605,6 +612,31 @@ class MultihostBackend(Backend):
         if not self.is_distributed():
             return None
         me = self.rank()
+        # preflight metadata rides the same gather transport but is accounted
+        # apart (preflight_calls/preflight_bytes): `bytes_gathered` must mean
+        # "state payload shipped" identically on every eager backend
+        calls0 = self._telemetry.get("gather_calls", 0)
+        bytes0 = self._telemetry.get("bytes_gathered", 0)
+        try:
+            return self._preflight_exchange(entries, update_count, delta_token, me)
+        finally:
+            tel = self._telemetry
+            dcalls = tel.get("gather_calls", 0) - calls0
+            dbytes = tel.get("bytes_gathered", 0) - bytes0
+            if dcalls:
+                tel["gather_calls"] -= dcalls
+                tel["preflight_calls"] = tel.get("preflight_calls", 0) + dcalls
+            if dbytes:
+                tel["bytes_gathered"] -= dbytes
+                tel["preflight_bytes"] = tel.get("preflight_bytes", 0) + dbytes
+
+    def _preflight_exchange(
+        self,
+        entries: Sequence[Tuple[str, str]],
+        update_count: int,
+        delta_token: Optional[Tuple[int, int, int]],
+        me: int,
+    ) -> Dict[str, Any]:
         flag, rnd, lo, hi = (1, *delta_token) if delta_token is not None else (0, 0, 0, 0)
         with self.annotate("preflight/schema"):
             meta = np.asarray(
@@ -729,12 +761,21 @@ class LoopbackBackend(Backend):
         self._telemetry["gather_calls"] = self._telemetry.get("gather_calls", 0) + 1
         self._telemetry["bytes_gathered"] = self._telemetry.get("bytes_gathered", 0) + int(nbytes)
 
+    def _count_preflight(self, nbytes: int) -> None:
+        self._telemetry["preflight_calls"] = self._telemetry.get("preflight_calls", 0) + 1
+        self._telemetry["preflight_bytes"] = self._telemetry.get("preflight_bytes", 0) + int(nbytes)
+
     def preflight_check(
         self,
         entries: Sequence[Tuple[str, str]],
         update_count: int = 0,
         delta_token: Optional[Tuple[int, int, int]] = None,
     ) -> Optional[Dict[str, Any]]:
+        # same two metadata exchanges as MultihostBackend at world size 1:
+        # a (1, 6) int32 meta row, then (1, S, 16) uint8 digest rows
+        self._count_preflight(6 * 4)
+        if entries:
+            self._count_preflight(16 * len(entries))
         return {"peer_update_counts": [int(update_count)], "delta_ok": delta_token is not None}
 
     def psum(self, x):
@@ -747,7 +788,11 @@ class LoopbackBackend(Backend):
     pmin = psum
 
     def all_gather_cat(self, x):
+        # MultihostBackend ships a sizes exchange before the row gather; a
+        # world of one pays the same two calls (4-byte int32 size + rows) so
+        # per-state and packed transports account identically across backends
         x = jnp.atleast_1d(jnp.asarray(x))
+        self._count(4)
         self._count(x.nbytes)
         return x
 
@@ -757,6 +802,9 @@ class LoopbackBackend(Backend):
         return x[None]
 
     def all_gather_bytes(self, payload: bytes) -> list:
+        # sizes exchange + padded blob gather — MultihostBackend's framing
+        # at world size 1
+        self._count(4)
         self._count(len(payload))
         return [payload]
 

@@ -1,0 +1,214 @@
+"""Mesh-native SPMD sync: metric state placed with ``NamedSharding``, the
+reduction lowered *inside* the compiled program.
+
+This is the third real backend beside :class:`MultihostBackend` (eager DCN
+gathers) and :class:`LoopbackBackend` (world-of-one accounting stand-in).
+Where those two move state through the host per sync — ``np.asarray``, blob
+packing, a KV-store round trip — :class:`MeshBackend` keeps every state leaf
+a ``jax.Array`` committed to an explicit device mesh:
+
+* ``dist_reduce_fx`` ``"sum"/"mean"/"max"/"min"`` lower to
+  ``lax.psum``/``pmean``/``pmax``/``pmin`` when the metric runs under
+  ``shard_map`` over the mesh axis (the in-trace tier it inherits from
+  :class:`AxisBackend`);
+* ``"cat"``/list/buffer states become device-sharded ``P('batch')`` arrays —
+  the gather is the in-XLA all-gather GSPMD inserts where the rows are
+  consumed, never a host concatenate;
+* sketch states fold through their merge function inside the traced program
+  (the per-rank trees are traced slices of one stacked gather, so the merge
+  compiles into the sync step instead of running eagerly per rank).
+
+Eagerly — the single-controller regime, where updates are jitted over
+*global* ``jax.Array`` batches and XLA has already inserted the cross-device
+reductions — a sync through this backend performs **no host transfer at
+all**: each reduced state is already the global value, so the collective is
+an identity that re-pins placement (replicated for reduced states, row-
+sharded for cat states) and counts one ``in_xla_reductions`` tick.  There
+are no wire bytes to account; the delta cache stands down (``supports_delta``
+is False) and the sync report carries ``in_xla_reductions`` instead of
+``bytes_gathered``.
+
+Contract: eager use assumes the single-controller global-array programming
+model (every ``update`` saw the full logical batch, sharded or not).  Feeding
+per-host *local* shards eagerly needs :class:`MultihostBackend` — see
+``docs/sharding.md`` for the decision table.
+"""
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.core
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from metrics_tpu.parallel.backend import AxisBackend, SyncOptions
+
+Array = jax.Array
+
+__all__ = ["MeshBackend", "default_mesh", "leaf_sharding", "replicated", "row_sharded"]
+
+
+def default_mesh(devices: Optional[Any] = None, axis_name: str = "batch") -> Mesh:
+    """A 1-D mesh over ``devices`` (default: all local devices)."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs.reshape(-1), (axis_name,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def row_sharded(mesh: Mesh, axis_name: str = "batch") -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def leaf_sharding(
+    mesh: Mesh,
+    value: Any,
+    spec: Optional[PartitionSpec],
+    axis_name: str = "batch",
+) -> NamedSharding:
+    """The effective ``NamedSharding`` for one state leaf.
+
+    ``spec`` wins when it fits the leaf (rank and divisibility); anything
+    that cannot shard evenly falls back to replication — the SNIPPETS
+    ``get_naive_sharding`` discipline, so placement never changes values,
+    only layout.
+    """
+    if spec is None:
+        return replicated(mesh)
+    dims = tuple(spec)
+    shape = tuple(getattr(value, "shape", ()))
+    if len(dims) > len(shape):
+        return replicated(mesh)
+    for i, ax in enumerate(dims):
+        if ax is None:
+            continue
+        names = (ax,) if isinstance(ax, str) else tuple(ax)
+        size = 1
+        for name in names:
+            if name not in mesh.shape:
+                return replicated(mesh)
+            size *= mesh.shape[name]
+        if shape[i] == 0 or shape[i] % size:
+            return replicated(mesh)
+    return NamedSharding(mesh, spec)
+
+
+class MeshBackend(AxisBackend):
+    """In-program collectives over an explicit :class:`jax.sharding.Mesh`.
+
+    In-trace (under ``shard_map`` over ``axis_name``) every reduction is the
+    inherited ``lax`` collective.  Eagerly the state is already the global
+    value (single-controller semantics), so collectives only re-pin
+    ``NamedSharding`` placement and tick telemetry — no host round trip.
+    """
+
+    eager = False
+    supports_delta = False
+    supports_packed = False
+    #: sync reports record ``in_xla_reductions`` instead of wire bytes
+    in_xla = True
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "batch",
+        options: Optional[SyncOptions] = None,
+    ):
+        super().__init__(axis_name)
+        self.mesh = mesh if mesh is not None else default_mesh(axis_name=axis_name)
+        if axis_name not in self.mesh.shape:
+            raise ValueError(
+                f"axis {axis_name!r} is not an axis of the mesh (axes: "
+                f"{tuple(self.mesh.shape)})"
+            )
+        self.options = options if options is not None else SyncOptions.from_env()
+        self._telemetry: Dict[str, Any] = {}
+
+    def pop_telemetry(self) -> Optional[Dict[str, Any]]:
+        out, self._telemetry = self._telemetry, {}
+        return out
+
+    def is_distributed(self) -> bool:
+        return int(self.mesh.devices.size) > 1
+
+    def world_size(self) -> int:
+        # static: one program spans the whole mesh, in-trace and eagerly
+        return int(self.mesh.devices.size)
+
+    # ------------------------------------------------------------- telemetry
+    def _tick(self, n: int = 1) -> None:
+        self._telemetry["in_xla_reductions"] = (
+            self._telemetry.get("in_xla_reductions", 0) + n
+        )
+
+    @staticmethod
+    def _traced(x: Any) -> bool:
+        return isinstance(x, jax.core.Tracer)
+
+    def _place(self, x: Array, spec: PartitionSpec) -> Array:
+        """Re-pin ``x`` onto the mesh (async device transfer, no host copy)."""
+        sharding = leaf_sharding(self.mesh, x, spec, self.axis_name)
+        if getattr(x, "sharding", None) == sharding:
+            return x
+        return jax.device_put(x, sharding)
+
+    # ------------------------------------------------------------ collectives
+    def psum(self, x):
+        if self._traced(x):
+            return super().psum(x)
+        self._tick()
+        return self._place(jnp.asarray(x), PartitionSpec())
+
+    def pmean(self, x):
+        if self._traced(x):
+            return super().pmean(x)
+        self._tick()
+        return self._place(jnp.asarray(x), PartitionSpec())
+
+    def pmax(self, x):
+        if self._traced(x):
+            return super().pmax(x)
+        self._tick()
+        return self._place(jnp.asarray(x), PartitionSpec())
+
+    def pmin(self, x):
+        if self._traced(x):
+            return super().pmin(x)
+        self._tick()
+        return self._place(jnp.asarray(x), PartitionSpec())
+
+    def all_gather_cat(self, x):
+        if self._traced(x):
+            return super().all_gather_cat(x)
+        self._tick()
+        rows = jnp.atleast_1d(jnp.asarray(x))
+        return self._place(rows, PartitionSpec(self.axis_name))
+
+    def all_gather_list(self, entries: Sequence[Array]) -> list:
+        """Identity gather for list states: the local rows ARE the global rows.
+
+        Under single-controller semantics every appended entry already spans
+        the whole mesh, so a per-sync concatenate would rebuild O(total) rows
+        each step for nothing.  The rows stay a lazy list; the in-XLA
+        all-gather is inserted by GSPMD wherever ``compute`` consumes them.
+        """
+        self._tick()
+        return list(entries)
+
+    def all_gather_stack(self, x):
+        if self._traced(x):
+            return super().all_gather_stack(x)
+        # eager: the local value IS the global value — a world-of-one stack
+        return jnp.asarray(x)[None]
+
+    def all_gather_merge(self, tree, merge_fn):
+        if any(self._traced(v) for v in tree.values()):
+            # in-trace: the stacked gather + merge fold compile into the sync
+            # program itself (per-rank trees are traced slices, so merge_fn
+            # lowers to XLA ops over the gathered leaves)
+            return super().all_gather_merge(tree, merge_fn)
+        self._tick()
+        return {k: self._place(jnp.asarray(v), PartitionSpec()) for k, v in tree.items()}

@@ -1,5 +1,6 @@
 # state-contract negatives: 0 findings expected
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.streaming.kll import kll_init, kll_merge
@@ -22,3 +23,14 @@ class GoodList(Metric):
     def __init__(self):
         super().__init__()
         self.add_state("rows", [], dist_reduce_fx="cat")
+
+
+class GoodSpecs(Metric):
+    stackable = False
+
+    def __init__(self):
+        super().__init__()
+        # replicated spec on a reduced state: fine
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum", spec=P())
+        # row-sharded spec on a gather-kind state: the intended pairing
+        self.add_state("rows", [], dist_reduce_fx="cat", spec=P("batch"))

@@ -1,6 +1,8 @@
-# state-contract positives: 5 findings expected
-# (reduce-default x2, list-state-reduce, sketch-merge, stackable-growing-state)
+# state-contract positives: 6 findings expected
+# (reduce-default x2, list-state-reduce, sketch-merge, stackable-growing-state,
+#  spec-reduce)
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from metrics_tpu.metric import Metric
 
@@ -20,3 +22,12 @@ class BadStackable(Metric):
     def __init__(self):
         super().__init__()
         self.add_buffer_state("preds")  # stackable-growing-state
+
+
+class BadSpec(Metric):
+    def __init__(self):
+        super().__init__()
+        # a row-sharded scalar-sum state: the reduce replicates it anyway
+        self.add_state(
+            "total", jnp.zeros(()), dist_reduce_fx="sum", spec=P("batch")
+        )  # spec-reduce

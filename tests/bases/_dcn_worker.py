@@ -33,6 +33,14 @@ Streaming scenario:
   quantiles of the UNION stream, and unsync must restore the local-only
   sketch afterwards.
 
+Mesh scenario:
+
+* ``mesh`` — each rank ``Metric.shard``\\ s its state onto its local device
+  mesh (``install_backend=False``) while sync rides the autodetected
+  MultihostBackend: synced values are the union, ``NamedSharding`` placement
+  survives sync/unsync, and a state_dict round trip re-pins restored leaves
+  (``sync.resharded_states``).
+
 Multistream scenario:
 
 * ``multistream`` — each rank feeds a disjoint stream range of a
@@ -363,6 +371,53 @@ def _scenario_ckpt_restore(rank: int, nproc: int) -> None:
     _sync_exit("ckpt_restore_exit")
 
 
+def _scenario_mesh(rank: int, nproc: int) -> None:
+    """Mesh placement under a real multi-host job: each rank pins its state
+    onto its *local* device mesh (placement only, ``install_backend=False``),
+    while sync rides the autodetected MultihostBackend over DCN.  The synced
+    value must be the union, the ``NamedSharding`` placement must survive the
+    sync/unsync cycle, and a state_dict round trip must re-pin the restored
+    leaves (``sync.resharded_states``)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from metrics_tpu import obs
+    from metrics_tpu.parallel.backend import MultihostBackend, get_backend
+    from metrics_tpu.parallel.mesh import default_mesh
+    from tests.bases.dummies import DummyListMetric, DummyMetricSum
+
+    assert isinstance(get_backend(), MultihostBackend)
+    mesh = default_mesh(jax.local_devices())
+
+    m = DummyMetricSum().shard(mesh, install_backend=False)
+    assert m.sync_backend is None  # cross-host sync autodetects Multihost
+    m.update(float(rank + 1))
+    total = float(m.compute())
+    assert total == sum(r + 1 for r in range(nproc)), total
+    assert m.last_sync_report["backend"] == "MultihostBackend"
+    # placement survived the sync/unsync cycle
+    assert m._state["x"].sharding == NamedSharding(mesh, PartitionSpec())
+
+    lm = DummyListMetric().shard(mesh, install_backend=False)
+    lm.update(np.arange(rank + 2, dtype=np.float32) + 10.0 * rank)
+    want = np.concatenate(
+        [np.arange(r + 2, dtype=np.float32) + 10.0 * r for r in range(nproc)]
+    )
+    np.testing.assert_allclose(np.asarray(lm.compute()), want)
+
+    before = obs.counter_value("sync.resharded_states", metric="DummyMetricSum")
+    m.load_state_dict(m.state_dict())
+    after = obs.counter_value("sync.resharded_states", metric="DummyMetricSum")
+    assert after > before, (before, after)
+    assert float(m.compute()) == total
+
+    print(f"DCN_MESH_OK rank={rank} total={total}", flush=True)
+    sys.stdout.flush()
+    _sync_exit("mesh_exit")
+
+
 def main() -> None:
     rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -393,6 +448,9 @@ def main() -> None:
         return
     if scenario == "ckpt_restore":
         _scenario_ckpt_restore(rank, nproc)
+        return
+    if scenario == "mesh":
+        _scenario_mesh(rank, nproc)
         return
     import numpy as np
     import jax.numpy as jnp

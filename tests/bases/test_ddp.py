@@ -205,6 +205,19 @@ def test_multihost_multistream_two_process():
 
 
 @pytest.mark.slow
+def test_multihost_mesh_two_process():
+    """Real 2-process DCN "mesh" job: each rank places its metric state on
+    its local device mesh (``Metric.shard`` with ``install_backend=False``)
+    while sync rides the autodetected MultihostBackend; synced values must
+    be the union, the ``NamedSharding`` placement must survive sync/unsync,
+    and a state_dict round trip must re-pin restored leaves
+    (``sync.resharded_states``)."""
+    for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="mesh", timeout=120)):
+        assert code == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_MESH_OK rank={r}" in out
+
+
+@pytest.mark.slow
 def test_multihost_checkpoint_save_kill_restore_resume(tmp_path):
     """Real 2-process preemption drill: first life accumulates and commits a
     checkpoint through the live coordination service (snapshot barrier, KV

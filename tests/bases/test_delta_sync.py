@@ -450,8 +450,43 @@ class TestPackedBlob:
         shards = bk.all_gather_bytes(b"\x01" * 100)
         assert shards == [b"\x01" * 100]
         tel = bk.pop_telemetry()
-        assert tel["gather_calls"] == 1 and tel["bytes_gathered"] == 100
+        # sizes exchange + padded blob: MultihostBackend's framing at world 1
+        assert tel["gather_calls"] == 2 and tel["bytes_gathered"] == 104
         assert bk.pop_telemetry() in (None, {})  # drained
+
+
+# --------------------------------------------- cross-backend byte accounting
+class TestAccountingConsistency:
+    """`sync.bytes_gathered` must mean "state payload shipped" on every
+    eager backend: preflight metadata rides apart (`preflight_bytes`), and
+    the packed-blob and per-state transports frame identically."""
+
+    def test_preflight_traffic_accounted_apart_from_state_bytes(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        _, reps = _rounds(m, 2, lambda step: jnp.arange(4.0) + step)
+        for rep in reps:
+            # meta row (24 B) + one digest row per sync state
+            assert rep["preflight_calls"] == 2
+            assert rep["preflight_bytes"] == 24 + 16 * 1
+            # the packed transport is exactly sizes + blob, no metadata mixed in
+            assert rep["gather_calls"] == 2
+            assert rep["bytes_gathered"] > 0
+
+    def test_scalar_one_shot_collectives_count_state_bytes(self):
+        bk = LoopbackBackend()
+        bk.psum(jnp.asarray(1.0, jnp.float32))
+        tel = bk.pop_telemetry()
+        assert tel["gather_calls"] == 1 and tel["bytes_gathered"] == 4
+        # through a metric on the per-state transport (ChaosBackend opts out
+        # of the packed blob): the report counts the float32 scalar, with the
+        # preflight metadata on its own ledger
+        per_state = ChaosBackend(LoopbackBackend(), schedule={})
+        m = DummyMetricSum(sync_backend=per_state)
+        _, reps = _rounds(m, 2, float)
+        for rep in reps:
+            assert rep["bytes_gathered"] == 4 and rep["gather_calls"] == 1
+            assert rep["preflight_calls"] == 2
+            assert rep["preflight_bytes"] == 24 + 16 * 1
 
 
 # ------------------------------------------------------------------ bench glue

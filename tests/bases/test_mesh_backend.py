@@ -1,0 +1,326 @@
+"""MeshBackend: PartitionSpec placement + in-XLA collective sync.
+
+Mesh-vs-loopback equivalence must be *bitwise* (float64 bit patterns,
+NaN-aware): the mesh path is advertised as a pure layout change, so any
+value drift — even one ULP — is a bug, not tolerance noise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu import Metric, obs
+from metrics_tpu.checkpoint.codec import (
+    arrays_to_merge_state,
+    arrays_to_pytree,
+    decode_metric,
+    encode_metric,
+)
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.parallel import LoopbackBackend, MeshBackend
+from metrics_tpu.parallel.mesh import default_mesh, leaf_sharding
+from metrics_tpu.streaming import StreamingQuantile
+from metrics_tpu.utils.data import dim_zero_cat
+
+from tests.bases.dummies import DummyListMetric, DummyMetricSum
+
+
+def _bits(x):
+    """float64 bit patterns: NaNs with identical payloads compare equal."""
+    return np.asarray(jax.device_get(x), dtype=np.float64).view(np.uint64)
+
+
+def assert_bitwise_equal(a, b):
+    ba, bb = _bits(a), _bits(b)
+    assert ba.shape == bb.shape
+    np.testing.assert_array_equal(ba, bb)
+
+
+class _Reduced(Metric):
+    """One scalar state under a configurable reduce."""
+
+    full_state_update = True
+    fx = "sum"
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        init = {"sum": 0.0, "mean": 0.0, "max": -jnp.inf, "min": jnp.inf}[self.fx]
+        self.add_state("v", jnp.asarray(init, jnp.float32), dist_reduce_fx=self.fx)
+
+    def update(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        if self.fx == "sum":
+            self.v = self.v + jnp.sum(x)
+        elif self.fx == "mean":
+            self.v = jnp.mean(x)
+        elif self.fx == "max":
+            self.v = jnp.maximum(self.v, jnp.max(x))
+        else:
+            self.v = jnp.minimum(self.v, jnp.min(x))
+
+    def compute(self):
+        return self.v
+
+
+class _SumM(_Reduced):
+    fx = "sum"
+
+
+class _MeanM(_Reduced):
+    fx = "mean"
+
+
+class _MaxM(_Reduced):
+    fx = "max"
+
+
+class _MinM(_Reduced):
+    fx = "min"
+
+
+def _synced_compute(m, backend=None):
+    if backend is not None:
+        m.sync_backend = backend
+    return m.compute()  # compute auto-syncs through the installed backend
+
+
+# ---------------------------------------------------------------- equivalence
+
+
+@pytest.mark.parametrize("cls", [_SumM, _MeanM, _MaxM, _MinM], ids=lambda c: c.fx)
+def test_mesh_vs_loopback_bitwise_reduced(cls):
+    batches = [jnp.asarray([0.1, 0.2, 0.7]), jnp.asarray([3.3, -1.5, 2.25])]
+    mesh_m, loop_m = cls().shard(), cls()
+    for b in batches:
+        mesh_m.update(b)
+        loop_m.update(b)
+    want = _synced_compute(loop_m, backend=LoopbackBackend())
+    got = _synced_compute(mesh_m)
+    assert_bitwise_equal(got, want)
+
+
+class _CatM(Metric):
+    """A cat-state metric whose compute CONSUMES the rows (like real metrics)."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("rows", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.rows.append(jnp.asarray(x, jnp.float32))
+
+    def compute(self):
+        return dim_zero_cat(self.rows) if isinstance(self.rows, list) else self.rows
+
+
+def test_mesh_vs_loopback_bitwise_cat_with_nans():
+    rows = jnp.asarray([1.0, jnp.nan, 0.3, -0.0, jnp.inf, 2.5, -7.0, jnp.nan])
+    mesh_m, loop_m = _CatM().shard(), _CatM()
+    mesh_m.update(rows)
+    loop_m.update(rows)
+    want = _synced_compute(loop_m, backend=LoopbackBackend())
+    got = _synced_compute(mesh_m)
+    # NaN-aware: identical bit patterns, including the -0.0 and NaN rows
+    assert_bitwise_equal(got, want)
+
+
+def test_mesh_vs_loopback_bitwise_sketch():
+    vals = np.random.default_rng(3).normal(size=(256,)).astype(np.float32)
+    mesh_m = StreamingQuantile(q=(0.1, 0.5, 0.9)).shard()
+    loop_m = StreamingQuantile(q=(0.1, 0.5, 0.9))
+    mesh_m.update(jnp.asarray(vals))
+    loop_m.update(jnp.asarray(vals))
+    want = _synced_compute(loop_m, backend=LoopbackBackend())
+    got = _synced_compute(mesh_m)
+    assert_bitwise_equal(got, want)
+
+
+# ------------------------------------------------------------------ placement
+
+
+def test_shard_places_reduced_states_replicated():
+    mesh = default_mesh()
+    m = DummyMetricSum().shard(mesh)
+    m.update(2.0)
+    m._flush_pending()
+    assert m._state["x"].sharding == NamedSharding(mesh, P())
+    assert isinstance(m.sync_backend, MeshBackend)
+    assert m.sync_backend.world_size() == len(jax.devices())
+
+
+def test_synced_list_state_stays_lazy_rows_place_sharded():
+    # list states stay lazy through sync (the local rows ARE the global rows);
+    # materialized cat arrays get row-sharded P('batch') placement
+    m = DummyListMetric().shard()
+    m.update(jnp.arange(8.0))
+    with m.sync_context(distributed_available=True):
+        assert isinstance(m.x, list)
+        np.testing.assert_allclose(np.asarray(m.x[0]), np.arange(8.0))
+    assert isinstance(m.x, list)  # unsync restored the local list state
+    rows = m.sync_backend.all_gather_cat(jnp.arange(8.0))
+    assert rows.sharding.spec == P("batch")
+    np.testing.assert_allclose(np.asarray(rows), np.arange(8.0))
+
+
+def test_explicit_spec_wins_over_kind_default():
+    class Pinned(Metric):
+        full_state_update = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state(
+                "rows", jnp.zeros((8, 4)), dist_reduce_fx="cat", spec=P("batch")
+            )
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.rows
+
+    mesh = default_mesh()
+    m = Pinned().shard(mesh)
+    assert m._state["rows"].sharding == NamedSharding(mesh, P("batch"))
+
+
+def test_add_state_sharded_spec_contradicts_scalar_reduce():
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("v", jnp.zeros(()), dist_reduce_fx="sum", spec=P("batch"))
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.v
+
+    with pytest.raises(ValueError, match="contradicts"):
+        Bad()
+
+
+def test_leaf_sharding_fallback_to_replication():
+    mesh = default_mesh()
+    n = len(jax.devices())
+    # divisible leading dim: the spec applies
+    ok = leaf_sharding(mesh, jnp.zeros((n * 2, 3)), P("batch"))
+    assert ok.spec == P("batch")
+    # indivisible rows, rank-deficient leaves, unknown axes: replicate
+    assert leaf_sharding(mesh, jnp.zeros((n + 1,)), P("batch")).spec == P()
+    assert leaf_sharding(mesh, jnp.zeros(()), P("batch")).spec == P()
+    assert leaf_sharding(mesh, jnp.zeros((n,)), P("model")).spec == P()
+
+
+def test_mesh_backend_rejects_missing_axis():
+    with pytest.raises(ValueError, match="not an axis"):
+        MeshBackend(default_mesh(axis_name="batch"), axis_name="model")
+
+
+def test_placement_survives_reset():
+    mesh = default_mesh()
+    m = DummyMetricSum().shard(mesh)
+    m.update(1.0)
+    m.reset()
+    assert m._state["x"].sharding == NamedSharding(mesh, P())
+
+
+# ------------------------------------------------------------- sync telemetry
+
+
+def test_sync_report_records_in_xla_reductions_not_wire_bytes():
+    m = DummyMetricSum().shard()
+    m.update(3.0)
+    m.sync()
+    rep = m.last_sync_report
+    m.unsync()
+    assert rep["backend"] == "MeshBackend"
+    assert rep["world_size"] == len(jax.devices())
+    assert rep["in_xla_reductions"] >= 1
+    assert rep["gather_calls"] == 0 and rep["bytes_gathered"] == 0
+
+
+# ------------------------------------------------------- recompile stability
+
+
+def test_recompile_stability_across_epochs():
+    m = Accuracy(num_classes=3, validate_args=False).shard()
+    preds = jnp.asarray([0, 1, 2, 1])
+    target = jnp.asarray([0, 1, 1, 1])
+    for _ in range(2):  # warmup: trace update/compute once, settle placement
+        m.update(preds, target)
+        m.compute()
+        m.reset()
+    before = {k: v for k, v in obs.counters_snapshot().items() if k[0] == "jit_traces"}
+    for _ in range(3):
+        m.update(preds, target)
+        m.compute()
+        m.reset()
+    after = {k: v for k, v in obs.counters_snapshot().items() if k[0] == "jit_traces"}
+    assert after == before  # steady-state epochs retrace nothing
+
+
+# ------------------------------------------- checkpoint -> elastic resharding
+
+
+def test_shard_checkpoint_elastic_restore_smaller_mesh():
+    big = default_mesh(jax.devices())
+    small = default_mesh(jax.devices()[:4])
+    m = DummyMetricSum().shard(big)
+    m.update(5.0)
+    m.update(7.0)
+    enc = encode_metric(m)
+
+    fresh = DummyMetricSum().shard(small)
+    dec = decode_metric(enc.blob, enc.digests)
+    assert not dec.failed
+    before = obs.counter_value("sync.resharded_states", metric="DummyMetricSum")
+    fresh.merge_state(arrays_to_merge_state(fresh, dec.arrays), other_count=enc.update_count)
+    assert obs.counter_value("sync.resharded_states", metric="DummyMetricSum") > before
+    # merged leaves live on the NEW (smaller) mesh, replicated
+    assert fresh._state["x"].sharding == NamedSharding(small, P())
+    assert set(fresh._state["x"].sharding.device_set) == set(np.ravel(small.devices))
+    assert float(fresh.compute()) == 12.0
+
+
+def test_accuracy_codec_roundtrip_across_meshes():
+    preds = jnp.asarray([0, 1, 2, 1, 0, 2, 2, 1])
+    target = jnp.asarray([0, 1, 1, 1, 0, 2, 0, 1])
+    m = Accuracy(num_classes=3, validate_args=False).shard(default_mesh(jax.devices()))
+    m.update(preds, target)
+    want = m.compute()
+
+    small = default_mesh(jax.devices()[:2])
+    fresh = Accuracy(num_classes=3, validate_args=False).shard(small)
+    enc = encode_metric(m)
+    dec = decode_metric(enc.blob, enc.digests)
+    assert not dec.failed
+    # full codec restore (meta state carries the determined mode), then the
+    # placement hook re-pins every leaf onto the new, smaller mesh
+    fresh.load_state_pytree(arrays_to_pytree(fresh, dec.arrays))
+    got = fresh.compute()
+    assert_bitwise_equal(got, want)
+    for value in fresh._state.values():
+        if hasattr(value, "sharding"):
+            assert value.sharding.mesh == small
+
+
+# ----------------------------------------------------------- in-trace tier
+
+
+def test_mesh_backend_in_trace_collectives():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+    bk = MeshBackend(mesh)
+
+    def run(x):
+        v = x.squeeze()
+        return jnp.stack([bk.psum(v), bk.pmean(v), bk.pmax(v), bk.pmin(v)])[None]
+
+    xs = jnp.arange(8, dtype=jnp.float32)
+    out = jax.shard_map(run, mesh=mesh, in_specs=P("batch"), out_specs=P("batch"))(xs)
+    np.testing.assert_allclose(np.asarray(out)[0], [28.0, 3.5, 7.0, 0.0])
+    # traced collectives are lax ops, not eager re-pins: no telemetry ticks
+    assert not bk.pop_telemetry()

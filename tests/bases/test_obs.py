@@ -188,6 +188,23 @@ class TestExporters:
         assert span_series
         assert all(dict(labels).get("span") for _, labels in span_series)
 
+    def test_mesh_sync_counters_flow_to_exporters(self):
+        # tick all three mesh counters: placement, an eager in-XLA sync, and
+        # a checkpoint-restore reshard
+        m = Accuracy(num_classes=3, validate_args=False).shard()
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        m.compute()
+        m.load_state_dict(m.state_dict())
+        summary = obs.summarize_counters().get("sync", {})
+        assert summary.get("mesh_placements", 0) > 0
+        assert summary.get("in_xla_reductions", 0) > 0
+        assert summary.get("resharded_states", 0) > 0
+        parsed = obs.parse_prometheus_text(obs.prometheus_text())
+        for field in ("mesh_placements", "in_xla_reductions", "resharded_states"):
+            prom = f"metrics_tpu_sync_{field}_total"
+            series = [v for (name, _), v in parsed.items() if name == prom]
+            assert series and sum(series) > 0
+
     def test_parse_rejects_malformed_lines(self):
         with pytest.raises(ValueError):
             obs.parse_prometheus_text("metrics_tpu_x_total{a=unquoted} 1")

@@ -130,8 +130,9 @@ FIXTURE_CASES = [
     ("lock_order_pos.py", "lock-order", 3,
      {"blocking-under-lock", "blocking-callee-under-lock", "inconsistent-order"}),
     ("lock_order_neg.py", "lock-order", 0, set()),
-    ("state_contract_pos.py", "state-contract", 5,
-     {"reduce-default", "list-state-reduce", "sketch-merge", "stackable-growing-state"}),
+    ("state_contract_pos.py", "state-contract", 6,
+     {"reduce-default", "list-state-reduce", "sketch-merge", "stackable-growing-state",
+      "spec-reduce"}),
     ("state_contract_neg.py", "state-contract", 0, set()),
 ]
 

@@ -13,6 +13,11 @@ package:
   (rule ``list-state-reduce``).
 * **sketch merge** (rule ``sketch-merge``) — ``add_sketch_state`` needs a
   real ``merge_fn`` callable, not a literal.
+* **placement/reduce consistency** (rule ``spec-reduce``) — a sharded
+  ``spec=PartitionSpec('batch')`` contradicts a scalar reduction
+  (``sum``/``mean``/``max``/``min``): reduced states hold the full value on
+  every device after sync and must replicate (``P()``); only gather-kind
+  (``cat``/list/buffer) states shard their row axis.
 * **stackability** (rule ``stackable-growing-state``) — a metric class
   annotated ``stackable = True`` (it promises to work as a
   ``MultiStreamMetric`` base, where every state gains a leading
@@ -121,6 +126,24 @@ def _is_inf(node: ast.AST, unit: ModuleUnit, sign: int) -> bool:
     return False
 
 
+def _spec_is_sharded(node: ast.AST, unit: ModuleUnit) -> bool:
+    """Whether ``node`` is statically a PartitionSpec with a named axis
+    (``P('batch')``, ``PartitionSpec(None, 'model')``, ...); a bare ``P()``
+    or all-None spec replicates and is fine with any reduce."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = (unit.resolve(node.func) or "").rsplit(".", 1)[-1] or (
+        node.func.attr
+        if isinstance(node.func, ast.Attribute)
+        else getattr(node.func, "id", "")
+    )
+    if fn not in ("PartitionSpec", "P"):
+        return False
+    return any(
+        not (isinstance(a, ast.Constant) and a.value is None) for a in node.args
+    )
+
+
 def _is_nonzero(node: ast.AST, unit: ModuleUnit) -> bool:
     """Whether ``node`` is statically a provably-nonzero default."""
     if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
@@ -194,6 +217,24 @@ class StateContractPass(AnalysisPass):
         reduce_node = node.args[2] if len(node.args) > 2 else _kwarg(node, "dist_reduce_fx")
         reduce_fx = _const_str(reduce_node)
         detail = f"{where}:{state_name}"
+        spec_node = _kwarg(node, "spec")
+        if (
+            spec_node is not None
+            and reduce_fx in ("sum", "mean", "max", "min")
+            and _spec_is_sharded(spec_node, unit)
+        ):
+            problems.append(
+                self.finding(
+                    unit.rel,
+                    node.lineno,
+                    "spec-reduce",
+                    detail,
+                    f"state {state_name!r} declares a sharded spec= placement "
+                    f"but reduces with {reduce_fx!r} — reduced states hold the "
+                    "full value on every device after sync and must replicate "
+                    "(P()); only cat/list/buffer states shard their row axis",
+                )
+            )
         if isinstance(default, ast.List) and not default.elts:
             if reduce_fx is not None and reduce_fx != "cat":
                 problems.append(

@@ -29,6 +29,7 @@ DOMAINS = [
     ("multistream", "Multistream"),
     ("checkpoint", "Checkpoint"),
     ("serve", "Serve"),
+    ("parallel", "Parallel"),
 ]
 
 OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs", "api")
