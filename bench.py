@@ -1444,6 +1444,192 @@ def _map_ddp_worker(rank, nproc, port, n_batches, batch_size):
     print(f"MAP_DDP_OBS {fields}", flush=True)
 
 
+def _map_ddp_async_worker(rank, nproc, port, n_batches, batch_size):
+    """Config 5 async variant: the same mAP + dist_sync_on_step loop with the
+    per-step gather running on the background sync worker, swept across
+    injected per-collective stalls.  Flat step time across the sweep means
+    the RTT really is hidden behind compute; the overlap counters say how
+    much latency each level absorbed."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=rank
+    )
+    from metrics_tpu import MeanAveragePrecision
+    from metrics_tpu.obs import counters_snapshot, summarize_counters
+    from metrics_tpu.parallel import ChaosBackend
+    from metrics_tpu.parallel.backend import get_backend
+
+    def _sync_summary():
+        return summarize_counters(counters_snapshot()).get("sync", {})
+
+    def _jit_traces():
+        return sum(
+            v for (name, _), v in counters_snapshot().items() if name == "jit_traces"
+        )
+
+    rng = np.random.default_rng(100 + rank)
+    # DISTINCT batches per level and step: a streaming evaluation sees fresh
+    # content every step, so each forward pays real IoU assembly — the
+    # compute the background gather is supposed to hide behind.  (Reused
+    # batches hit the IoU content cache and leave nothing to overlap.)
+    per_level = {
+        stall_ms: [_make_detection_batch(rng, batch_size) for _ in range(n_batches)]
+        for stall_ms in (5, 25, 100)
+    }
+    inner = get_backend()
+    # one untimed priming epoch fills the jit caches (shapes are shared by
+    # every level), so level 1 of the sweep doesn't pay the compiles
+    prime = MeanAveragePrecision(
+        dist_sync_on_step=True, async_sync=True,
+        sync_backend=ChaosBackend(inner, packed=True, stall_secs=0.005),
+    )
+    for preds, targets in [_make_detection_batch(rng, batch_size) for _ in range(2)]:
+        prime.forward(preds, targets)
+    prime.compute()
+    results = {}
+    for stall_ms, batches in per_level.items():
+        chaos = ChaosBackend(inner, packed=True, stall_secs=stall_ms / 1000.0)
+        metric = MeanAveragePrecision(
+            dist_sync_on_step=True, async_sync=True, sync_backend=chaos
+        )
+        metric.forward(*_make_detection_batch(rng, batch_size))  # first round warm
+        metric.reset()
+        sync_before, jit_before = _sync_summary(), _jit_traces()
+        step_times = []
+        start = time.perf_counter()
+        for preds, targets in batches:
+            s0 = time.perf_counter()
+            metric.forward(preds, targets)  # kicks the round, returns local value
+            step_times.append(time.perf_counter() - s0)
+        metric.compute()  # final catch-up barrier + suffix sync
+        elapsed = time.perf_counter() - start
+        sync_after, jit_after = _sync_summary(), _jit_traces()
+        step_times.sort()
+        results[str(stall_ms)] = {
+            "median_step_secs": round(step_times[len(step_times) // 2], 6),
+            "epoch_secs": round(elapsed, 6),
+            "async_rounds": int(sync_after.get("async_rounds", 0))
+            - int(sync_before.get("async_rounds", 0)),
+            "catchup_barriers": int(sync_after.get("catchup_barriers", 0))
+            - int(sync_before.get("catchup_barriers", 0)),
+            "overlap_secs": round(
+                float(sync_after.get("overlap_secs", 0.0))
+                - float(sync_before.get("overlap_secs", 0.0)),
+                4,
+            ),
+            "timed_recompiles": jit_after - jit_before,
+        }
+    # synchronous contrast sweep: same loop, async off, so every step pays
+    # the full per-collective stall inline.  Reuses level-5 batches — their
+    # IoU blocks are content-cached, so step time is almost pure exposed
+    # RTT and the slope reads as ~collectives-per-round.
+    sync_results = {}
+    for stall_ms in (5, 100):
+        chaos = ChaosBackend(inner, packed=True, stall_secs=stall_ms / 1000.0)
+        metric = MeanAveragePrecision(dist_sync_on_step=True, sync_backend=chaos)
+        metric.forward(*per_level[5][0])
+        metric.reset()
+        step_times = []
+        for preds, targets in per_level[5]:
+            s0 = time.perf_counter()
+            metric.forward(preds, targets)
+            step_times.append(time.perf_counter() - s0)
+        metric.compute()
+        step_times.sort()
+        sync_results[str(stall_ms)] = {
+            "median_step_secs": round(step_times[len(step_times) // 2], 6),
+        }
+    print(
+        f"MAP_DDP_ASYNC_OK {json.dumps({'async': results, 'sync': sync_results})}",
+        flush=True,
+    )
+
+
+def _bench_detection_ddp_async(nproc=2, n_batches=6, batch_size=32):
+    """Config 5 async variant driver: spawn the 2-process sweep, compute the
+    step-time-vs-RTT slope across the 5/25/100 ms stall levels."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--map-ddp-async-worker",
+             str(rank), str(nproc), str(port), str(n_batches), str(batch_size)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for rank in range(nproc)
+    ]
+    per_rank = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            for line in out.decode().splitlines():
+                if line.startswith("MAP_DDP_ASYNC_OK"):
+                    per_rank.append(json.loads(line.split(None, 1)[1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if len(per_rank) != nproc:
+        raise RuntimeError("map ddp async workers failed")
+    # workers are symmetric: keep the slower rank's step time per stall level
+    # (the fleet moves at the straggler's pace) and sum the overlap they hid
+    levels = sorted(per_rank[0]["async"], key=float)
+    merged = {}
+    for level in levels:
+        merged[level] = {
+            "median_step_secs": max(r["async"][level]["median_step_secs"] for r in per_rank),
+            "overlap_secs": round(
+                sum(r["async"][level]["overlap_secs"] for r in per_rank), 4
+            ),
+            "async_rounds": max(r["async"][level]["async_rounds"] for r in per_rank),
+            "catchup_barriers": max(
+                r["async"][level]["catchup_barriers"] for r in per_rank
+            ),
+            "timed_recompiles": max(
+                r["async"][level]["timed_recompiles"] for r in per_rank
+            ),
+        }
+    lo, hi = levels[0], levels[-1]
+    rtt_span = (float(hi) - float(lo)) / 1000.0
+    slope = (merged[hi]["median_step_secs"] - merged[lo]["median_step_secs"]) / rtt_span
+    sync_lo = max(r["sync"][lo]["median_step_secs"] for r in per_rank)
+    sync_hi = max(r["sync"][hi]["median_step_secs"] for r in per_rank)
+    sync_slope = (sync_hi - sync_lo) / rtt_span
+    profile = {
+        "per_stall_ms": merged,
+        # added step seconds per added second of injected per-collective RTT:
+        # 0 = fully hidden; the synchronous contrast slope below is what the
+        # same loop pays with async off (~collectives per round)
+        "step_vs_rtt_slope": round(slope, 4),
+        "sync_step_vs_rtt_slope": round(sync_slope, 4),
+        "hidden_rtt_fraction": round(1.0 - slope / sync_slope, 4) if sync_slope else None,
+        "sync_step_secs_5ms": round(sync_lo, 6),
+        "sync_step_secs_100ms": round(sync_hi, 6),
+        "step_ratio_100ms_vs_5ms": round(
+            merged[hi]["median_step_secs"] / merged[lo]["median_step_secs"], 4
+        ),
+        "sync_step_ratio_100ms_vs_5ms": round(sync_lo and sync_hi / sync_lo, 4),
+        "timed_recompiles": max(m["timed_recompiles"] for m in merged.values()),
+        "note": "per-step packed gather on the background worker under recurring "
+        "per-collective stalls; flat step time across 5/25/100ms = RTT hidden; "
+        "sync_* is the same loop with async off (RTT fully exposed); both "
+        "workers and their background sync threads share this host's 1 core, "
+        "so the residual async slope is CPU contention, not exposed RTT",
+    }
+    rate = (nproc * n_batches * batch_size) / max(
+        m["median_step_secs"] * n_batches for m in merged.values()
+    )
+    return rate, profile
+
+
 def _obs_counters():
     """Raw obs counter snapshot (counters tick even with spans disabled)."""
     from metrics_tpu.obs import counters_snapshot
@@ -1509,6 +1695,7 @@ def main() -> None:
         ("config3_image_fid2048_samples_per_sec", _bench_image),
         ("config4_bertscore_rouge_sentences_per_sec", _bench_text),
         ("config5_map_ddp_images_per_sec", _bench_detection_ddp),
+        ("config5_map_ddp_async_images_per_sec", _bench_detection_ddp_async),
         ("config5_map_coco_scale_images_per_sec", _bench_map_coco_scale),
         ("config5_map_segm_scale_images_per_sec", _bench_map_segm_scale),
         ("config6_streaming_samples_per_sec", _bench_streaming),
@@ -1525,6 +1712,38 @@ def main() -> None:
                 extra[name] = round(result[0], 1)
                 extra["config3_fid_pretrained"] = result[1]
                 extra["config3_breakdown"] = result[2]
+            elif name.startswith("config5_map_ddp_async"):
+                extra[name] = round(result[0], 1)
+                extra["config5_map_ddp_async_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) carries the latency-hiding proof: the slope, the
+                # per-level overlap the background rounds absorbed, and the
+                # static-shape guarantee for the swept loop
+                extra["config5_map_ddp_async_step_vs_rtt_slope"] = result[1][
+                    "step_vs_rtt_slope"
+                ]
+                extra["config5_map_ddp_async_sync_step_vs_rtt_slope"] = result[1][
+                    "sync_step_vs_rtt_slope"
+                ]
+                extra["config5_map_ddp_async_hidden_rtt_fraction"] = result[1][
+                    "hidden_rtt_fraction"
+                ]
+                extra["config5_map_ddp_async_step_ratio_100ms_vs_5ms"] = result[1][
+                    "step_ratio_100ms_vs_5ms"
+                ]
+                extra["config5_map_ddp_async_sync_step_ratio_100ms_vs_5ms"] = result[1][
+                    "sync_step_ratio_100ms_vs_5ms"
+                ]
+                extra["config5_map_ddp_async_timed_recompiles"] = result[1][
+                    "timed_recompiles"
+                ]
+                for level, stats in result[1]["per_stall_ms"].items():
+                    extra[f"config5_map_ddp_async_step_secs_{level}ms"] = stats[
+                        "median_step_secs"
+                    ]
+                    extra[f"config5_map_ddp_async_overlap_secs_{level}ms"] = stats[
+                        "overlap_secs"
+                    ]
             elif name.startswith("config5_map_ddp"):
                 extra[name] = round(result[0], 1)
                 extra["config5_map_ddp_profile"] = result[1]
@@ -1655,6 +1874,8 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--map-ddp-worker":
         _map_ddp_worker(*(int(x) for x in sys.argv[2:7]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--map-ddp-async-worker":
+        _map_ddp_async_worker(*(int(x) for x in sys.argv[2:7]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--mesh-ddp-worker":
         _mesh_ddp_worker(*(int(x) for x in sys.argv[2:6]))
     else:

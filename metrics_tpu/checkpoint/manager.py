@@ -61,6 +61,22 @@ Target = Union[Metric, MetricCollection, MetricTracker]
 _RESTORE_POLICIES = ("raise", "skip_state", "reset_metric")
 
 
+@dataclass
+class EncodedTarget:
+    """Serialized metric blobs ready to commit — the output of
+    :meth:`CheckpointManager.encode_target`, accepted by
+    :meth:`CheckpointManager.save`.
+
+    Splitting serialization from the store/barrier commit lets a serving
+    process encode each metric under its own short per-job lock and run the
+    (slow, possibly faulted) store writes with no lock held at all.
+    """
+
+    shard_blobs: Dict[str, bytes]
+    shard_meta: Dict[str, Any]
+    manifest_schema: Dict[str, Any]
+
+
 def _step_dir(step: int) -> str:
     return f"step_{step:08d}"
 
@@ -212,13 +228,56 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------ save
 
-    def save(self, target: Target, step: Optional[int] = None) -> int:
+    def encode_target(
+        self, target: Target, lock_for: Optional[Any] = None
+    ) -> EncodedTarget:
+        """Serialize every metric in ``target`` to its checkpoint blobs.
+
+        Pure host-side work — no store writes, no barriers.  ``lock_for``
+        (``key -> context manager``) is entered around each metric's encode,
+        so a serving process can hold one short per-job lock per metric
+        instead of quiescing the whole registry for the full snapshot; the
+        result is per-metric-consistent rather than cross-metric
+        point-in-time, which is exactly the consistency the restore path
+        needs (each metric restores independently).
+        """
+        from contextlib import nullcontext
+
+        metrics = flatten_target(target)
+        shard_meta: Dict[str, Any] = {"metrics": {}}
+        manifest_schema: Dict[str, Any] = {}
+        shard_blobs: Dict[str, bytes] = {}
+        for key, metric in metrics.items():
+            with (lock_for(key) if lock_for is not None else nullcontext()):
+                enc = codec.encode_metric(metric)
+            shard_blobs[key] = enc.blob
+            shard_meta["metrics"][key] = {
+                "digests": enc.digests,
+                "update_count": enc.update_count,
+                "sync_round": enc.sync_round,
+            }
+            manifest_schema[key] = {"type": type(metric).__name__, "kinds": enc.kinds}
+        return EncodedTarget(
+            shard_blobs=shard_blobs,
+            shard_meta=shard_meta,
+            manifest_schema=manifest_schema,
+        )
+
+    def save(
+        self,
+        target: Target,
+        step: Optional[int] = None,
+        encoded: Optional[EncodedTarget] = None,
+    ) -> int:
         """Commit one checkpoint of ``target``; returns the step committed.
 
         All ranks must call this collectively with the same ``step`` (or all
         with ``None``, which continues from the newest committed step).  The
         manifest write by rank 0 is the commit point; every rank returns only
         after observing it, so a ``save()`` that returned is durable.
+
+        Pass ``encoded`` (from :meth:`encode_target`) to commit blobs that
+        were serialized earlier — the non-blocking snapshot path.
         """
         if step is None:
             latest = self.latest_step()
@@ -227,23 +286,17 @@ class CheckpointManager:
         with span("ckpt.save", step=step, rank=self.rank):
             self._barrier(f"save-entry/{seq}/{step}")
             sdir = _step_dir(step)
-            metrics = flatten_target(target)
-            shard_meta: Dict[str, Any] = {"metrics": {}}
-            manifest_schema: Dict[str, Any] = {}
-            shard_blobs: Dict[str, bytes] = {}
-            for key, metric in metrics.items():
-                enc = codec.encode_metric(metric)
-                shard_blobs[key] = enc.blob
-                shard_meta["metrics"][key] = {
-                    "digests": enc.digests,
-                    "update_count": enc.update_count,
-                    "sync_round": enc.sync_round,
-                }
-                manifest_schema[key] = {"type": type(metric).__name__, "kinds": enc.kinds}
+            if encoded is None:
+                encoded = self.encode_target(target)
+            shard_meta = encoded.shard_meta
+            manifest_schema = encoded.manifest_schema
             import numpy as np
 
             shard = codec._pack_state_blob(
-                {key: np.frombuffer(blob, np.uint8) for key, blob in shard_blobs.items()}
+                {
+                    key: np.frombuffer(blob, np.uint8)
+                    for key, blob in encoded.shard_blobs.items()
+                }
             )
             self.store.write_atomic(f"{sdir}/{_shard_name(self.rank)}", shard)
             counter_inc("ckpt.bytes_written", value=len(shard))
@@ -304,10 +357,15 @@ class CheckpointManager:
             return None
         return max(0.0, self.max_staleness - self.staleness())
 
-    def save_now(self, target: Target, step: Optional[int] = None) -> int:
+    def save_now(
+        self,
+        target: Target,
+        step: Optional[int] = None,
+        encoded: Optional[EncodedTarget] = None,
+    ) -> int:
         """Unconditional checkpoint: commit, disarm any pending
         :meth:`request_save`, and reset the staleness clock."""
-        committed = self.save(target, step=step)
+        committed = self.save(target, step=step, encoded=encoded)
         self._save_requested.clear()
         return committed
 

@@ -502,6 +502,25 @@ class MetricCollection:
                     evicted[name] = m.advance()
         return evicted
 
+    def sync_async(self, backend: Optional[Any] = None) -> Dict[str, Any]:
+        """Kick one background sync round per member (per compute-group
+        LEADER when groups are active: members alias the leader's state and
+        delta cache, so one round covers the whole group).
+
+        Returns ``{member_name: AsyncSyncHandle | None}`` — ``None`` entries
+        mean the member declined (kill switch or ineligible backend).  The
+        catch-up barriers happen inside each member's next ``sync`` /
+        ``compute``, exactly as for a standalone metric.
+        """
+        handles: Dict[str, Any] = {}
+        if self._groups_checked and self._compute_groups:
+            for group in self._compute_groups.values():
+                handles[group[0]] = self._modules[group[0]].sync_async(backend=backend)
+        else:
+            for name, m in self._modules.items():
+                handles[name] = m.sync_async(backend=backend)
+        return handles
+
     def compute(self) -> Dict[str, Any]:
         if _OBS_RT.enabled:
             # member metric.compute spans nest under this one, giving
@@ -651,6 +670,7 @@ class MetricCollection:
             "full_syncs": 0,
             "in_xla_reductions": 0,
             "backoff_secs": 0.0,
+            "overlap_secs": 0.0,
             "errors": [],
         }
         for name, m in self._modules.items():
@@ -663,6 +683,9 @@ class MetricCollection:
             )
             totals["backoff_secs"] = round(
                 totals["backoff_secs"] + float(rep.get("backoff_secs") or 0.0), 6
+            )
+            totals["overlap_secs"] = round(
+                totals["overlap_secs"] + float(rep.get("overlap_secs") or 0.0), 6
             )
             for key in (
                 "retries",

@@ -41,7 +41,14 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
 from metrics_tpu.obs import core as _obs
-from metrics_tpu.parallel.backend import Backend, SyncOptions, get_backend, reduce_synced_state
+from metrics_tpu.parallel.backend import (
+    AsyncSyncHandle,
+    Backend,
+    SyncOptions,
+    get_backend,
+    reduce_synced_state,
+    submit_async_round,
+)
 from metrics_tpu.utils.data import _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utils.exceptions import (
     MetricsTPUUserError,
@@ -98,11 +105,19 @@ class _DeltaCache:
         self.prefixes: Dict[str, Any] = {}
         self.watermarks: Dict[str, int] = {}
         self.round = 0
+        #: async double-buffer slot: descriptor of the one in-flight
+        #: background sync round (None when nothing is parked)
+        self.inflight: Optional[Dict[str, Any]] = None
+        #: bumped on every clear; an async round submitted against an older
+        #: generation is stale and its result must be discarded, not folded
+        self.generation = 0
 
     def clear(self) -> None:
         self.prefixes.clear()
         self.watermarks.clear()
         self.round = 0
+        self.inflight = None
+        self.generation += 1
 
     def token(self, names: Sequence[str]) -> Tuple[int, int, int]:
         """``(round, digest_lo, digest_hi)`` int32-safe vote token.
@@ -354,6 +369,12 @@ class Metric(ABC):
             os.environ.get("METRICS_TPU_DELTA_SYNC", "").strip().lower()
             not in ("0", "false", "no"),
         )
+        # tri-state: None = sync_async() allowed but forward stays
+        # synchronous; True = forward also overlaps (opt-in — per-step values
+        # become local-only); False = kill switch, sync_async() is a no-op
+        self.async_sync = kwargs.pop("async_sync", None)
+        if os.environ.get("METRICS_TPU_ASYNC_SYNC", "").strip().lower() in ("0", "false", "no"):
+            self.async_sync = False
         self._delta_cache = _DeltaCache()
         self._last_synced_state: Optional[Dict[str, Any]] = None
         self.last_sync_report: Optional[Dict[str, Any]] = None
@@ -1795,7 +1816,11 @@ class Metric(ABC):
         try:
             self._reset_for_forward()
             self._update_now(*args, **kwargs)
-            should_sync = self.dist_sync_on_step
+            # explicit opt-in for overlapped per-step sync: the batch value
+            # becomes local-only (the gather runs in the background), which
+            # changes forward's return semantics — hence `is True`, not truthy
+            async_round = self.dist_sync_on_step and self.async_sync is True
+            should_sync = self.dist_sync_on_step and not async_round
             prev_sync = self.sync_on_compute
             self.sync_on_compute = should_sync
             try:
@@ -1811,6 +1836,11 @@ class Metric(ABC):
         self._update_count = cached_count
         self._computed = None
         self._is_synced = False
+        if async_round:
+            # overlapped dist_sync_on_step: fold in the PREVIOUS round's
+            # completed gather, then kick this round's on the background
+            # worker — the step pays the fold, never the wire
+            self.sync_async()
         if batch_synced is not None and self._forward_delta_advance and self.delta_sync:
             self._forward_advance_delta(cache, batch_state, batch_synced)
         return batch_val
@@ -2200,6 +2230,10 @@ class Metric(ABC):
             raise MetricsTPUUserError("The Metric has already been synced.")
         self._flush_pending()
         self._flush_host_buffers()
+        # final catch-up barrier: fold any in-flight background round first
+        # so the sync below ships only the post-snapshot suffix and the
+        # result stays bitwise-identical to a purely synchronous history
+        self._async_catchup()
         self._last_synced_state = None
         saved_options: Any = _UNSET
         if backend is None:
@@ -2322,6 +2356,131 @@ class Metric(ABC):
 
     def sync_context(self, **kwargs: Any) -> "Metric._SyncContext":
         return Metric._SyncContext(self, **kwargs)
+
+    def sync_async(self, backend: Optional[Backend] = None) -> Optional[AsyncSyncHandle]:
+        """Kick one packed sync round on the background sync worker and
+        return immediately with its :class:`AsyncSyncHandle`.
+
+        Double-buffered: at most one round is ever in flight — submitting
+        folds in the *previous* round's completed result first (the fold
+        advances the delta cache, so the next synchronous sync ships only
+        the rows appended after this call's snapshot).  The delta cache's
+        ``(round, digest)`` token is the ordering guarantee: the catch-up
+        barrier in :meth:`sync` / :meth:`compute` re-verifies it
+        collectively, keeping results bitwise-identical to the synchronous
+        path.  A failed background round is swallowed at fold time — the
+        cache is cleared and the next sync falls back to a full gather.
+
+        Returns ``None`` (no-op) when async sync is disabled
+        (``async_sync=False`` / ``METRICS_TPU_ASYNC_SYNC=0``) or the
+        resolved backend cannot run collectives off-thread.
+        """
+        if self._is_synced:
+            raise MetricsTPUUserError("Cannot start an async sync on a synced Metric.")
+        if self.async_sync is False:
+            return None
+        if backend is None:
+            backend = self.sync_backend
+        if backend is None:
+            backend = get_backend(self.axis_name, self._sync_options())
+        if (
+            not getattr(backend, "eager", False)
+            or not getattr(backend, "supports_packed", False)
+            or not getattr(backend, "supports_delta", False)
+            or not getattr(backend, "supports_async", False)
+            or not backend.is_distributed()
+            or self.dist_sync_fn is not None
+        ):
+            return None
+        # double buffer: fold the previous round before parking a new one
+        self._async_catchup()
+        self._flush_pending()
+        self._flush_host_buffers()
+        snapshot = self._copy_state()
+        count = self._update_count
+        entries = self._schema_entries()
+        delta_plan = self._build_delta_plan()
+        token = self._delta_cache.token(list(delta_plan)) if delta_plan else None
+        dc = self._delta_cache
+
+        def round_fn() -> Tuple[Optional[Dict[str, Any]], bool, Dict[str, Any]]:
+            # runs on the "mtpu-async-sync" worker: its collectives draw from
+            # the isolated async KV namespace, so they can never cross-match
+            # a concurrent main-thread gather's sequence numbers
+            info = backend.preflight_check(entries, count, delta_token=token)
+            delta_ok = bool(delta_plan) and bool((info or {}).get("delta_ok"))
+            new_state = self._sync_state_pure(
+                snapshot, backend, delta_plan if delta_ok else None
+            )
+            return info, delta_ok, new_state
+
+        handle = submit_async_round(round_fn, label=type(self).__name__)
+        dc.inflight = {
+            "handle": handle,
+            "snapshot": snapshot,
+            "generation": dc.generation,
+            "backend": backend,
+            "count": count,
+        }
+        _obs.counter_inc("sync.async_rounds", metric=type(self).__name__)
+        return handle
+
+    def _async_catchup(self) -> None:
+        """Fold in the in-flight background round, blocking if it has not
+        finished (the one catch-up barrier).  The fold installs the gathered
+        rows as the next delta prefix — local state is untouched, so a
+        subsequent synchronous sync reproduces the exact synchronous result.
+        """
+        dc = self._delta_cache
+        inflight, dc.inflight = dc.inflight, None
+        if inflight is None:
+            return
+        handle: AsyncSyncHandle = inflight["handle"]
+        backend: Backend = inflight["backend"]
+        waited = 0.0
+        if not handle.done.is_set():
+            _obs.counter_inc("sync.catchup_barriers", metric=type(self).__name__)
+            barrier_start = time.perf_counter()
+            handle.wait()
+            waited = time.perf_counter() - barrier_start
+        completed = handle.completed_at if handle.completed_at is not None else handle.submitted_at
+        overlap = max(0.0, (completed - handle.submitted_at) - waited)
+        report: Dict[str, Any] = {
+            "backend": type(backend).__name__,
+            "world_size": int(backend.world_size()),
+            "fallback": None,
+            "error": None,
+            "async": True,
+            "overlap_secs": round(overlap, 6),
+        }
+        try:
+            info, delta_ok, new_state = handle.result()
+        except SyncError as err:
+            # background round failed: drop the prefix induction so the next
+            # synchronous sync is a full gather — correctness never rests on
+            # the async round having landed
+            dc.clear()
+            report["error"] = f"{type(err).__name__}: {err}"
+            report["fallback"] = "full_gather"
+            self._finish_sync_report(report, backend, handle.submitted_at)
+            return
+        except BaseException:
+            dc.clear()
+            raise
+        if inflight["generation"] != dc.generation:
+            return  # cache was cleared while in flight: the round is stale
+        if info:
+            report.update(info)
+        if self.delta_sync:
+            # _advance_delta_cache reads self._cache for the watermark row
+            # counts; point it at the submit-time snapshot for the fold
+            saved_cache = self._cache
+            self._cache = inflight["snapshot"]
+            try:
+                self._advance_delta_cache(new_state, delta_ok, report)
+            finally:
+                self._cache = saved_cache
+        self._finish_sync_report(report, backend, handle.submitted_at)
 
     # ---------------------------------------------------------------- compute
     def _compute_wrapper(self) -> Any:

@@ -244,6 +244,9 @@ def record_sync_report(metric: str, report: Dict[str, Any]) -> None:
     backoff = report.get("backoff_secs") or 0.0
     if backoff:
         counter_inc("sync.backoff_secs", float(backoff), metric=metric)
+    overlap = report.get("overlap_secs") or 0.0
+    if overlap:
+        counter_inc("sync.overlap_secs", float(overlap), metric=metric)
 
 
 def sync_reports(metric: Optional[str] = None) -> List[Dict[str, Any]]:

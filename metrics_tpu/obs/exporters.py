@@ -289,7 +289,8 @@ def summarize_counters(
         out["recompiles_by_metric"] = {k: int(v) for k, v in sorted(by_metric.items())}
     if sync:
         out["sync"] = {
-            k: (round(v, 6) if k == "backoff_secs" else int(v)) for k, v in sorted(sync.items())
+            k: (round(v, 6) if k in ("backoff_secs", "overlap_secs") else int(v))
+            for k, v in sorted(sync.items())
         }
     if streaming:
         out["streaming"] = {k: int(v) for k, v in sorted(streaming.items())}

@@ -1,6 +1,7 @@
 """Distributed runtime: mesh-axis collectives (ICI) + multihost DCN sync."""
 
 from metrics_tpu.parallel.backend import (
+    AsyncSyncHandle,
     AxisBackend,
     Backend,
     LoopbackBackend,
@@ -14,11 +15,13 @@ from metrics_tpu.parallel.backend import (
     guarded_collective,
     reduce_synced_state,
     schema_digest_rows,
+    submit_async_round,
 )
 from metrics_tpu.parallel.faults import ChaosBackend, ChaosInjectedError, ChaosInjectedSyncError
 from metrics_tpu.parallel.mesh import MeshBackend, default_mesh, leaf_sharding
 
 __all__ = [
+    "AsyncSyncHandle",
     "AxisBackend",
     "Backend",
     "ChaosBackend",
@@ -38,4 +41,5 @@ __all__ = [
     "leaf_sharding",
     "reduce_synced_state",
     "schema_digest_rows",
+    "submit_async_round",
 ]

@@ -26,6 +26,7 @@ import dataclasses
 import hashlib
 import itertools
 import os
+import queue
 import threading
 import time
 from contextlib import contextmanager
@@ -272,6 +273,103 @@ def find_schema_divergence(
 #: is SPMD (same collectives, same order)
 _KV_SEQ = itertools.count()
 
+#: separate sequence space for collectives issued by the async sync worker:
+#: the worker runs concurrently with main-thread collectives, so without a
+#: namespace split the two threads would interleave ``next(_KV_SEQ)`` draws
+#: nondeterministically across ranks and mismatch payload keys.  Async rounds
+#: are submitted in SPMD order and drained by ONE FIFO worker per process, so
+#: this counter advances identically on every rank too.
+_ASYNC_KV_SEQ = itertools.count()
+
+_ASYNC_NS = threading.local()  # .active is True only on the async sync worker
+
+
+def _kv_namespace() -> Tuple[str, Any]:
+    """(key prefix, sequence counter) for the calling thread's collectives."""
+    if getattr(_ASYNC_NS, "active", False):
+        return "mtpu/aga", _ASYNC_KV_SEQ
+    return "mtpu/ag", _KV_SEQ
+
+
+class AsyncSyncHandle:
+    """Future for one background sync round submitted via :func:`submit_async_round`.
+
+    ``wait`` parks the caller until the worker finishes (the catch-up
+    barrier); ``result`` re-raises whatever the round raised on the worker.
+    Timestamps (``submitted_at`` / ``completed_at``, ``time.perf_counter``
+    domain) let the caller attribute how much of the round's wall time was
+    hidden behind compute (``sync.overlap_secs``).
+    """
+
+    __slots__ = ("label", "done", "value", "error", "submitted_at", "completed_at")
+
+    def __init__(self, label: str) -> None:
+        self.label = label
+        self.done = threading.Event()
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+        self.submitted_at = time.perf_counter()
+        self.completed_at: Optional[float] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    def result(self) -> Any:
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class _AsyncSyncWorker:
+    """The dedicated background sync thread (one per process).
+
+    A single FIFO daemon thread drains whole sync rounds — preflight, packed
+    gather, reassembly — off the critical path.  ONE worker (not one per
+    metric) is a correctness requirement, not an optimization: rounds are
+    submitted in SPMD program order on every rank, and a single FIFO consumer
+    preserves that order end-to-end, so the async KV sequence numbers match
+    across ranks.  While idle the worker parks in an untimed ``queue.get``
+    holding no lock at all — the lock-witness pass checks exactly this.
+    """
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        # guards lazy thread (re)start only; never held around queue ops
+        self._start_lock = threading.Lock()
+
+    def submit(self, fn: Callable[[], Any], label: str) -> AsyncSyncHandle:
+        handle = AsyncSyncHandle(label)
+        with self._start_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="mtpu-async-sync"
+                )
+                self._thread.start()
+        self._q.put_nowait((fn, handle))
+        return handle
+
+    def _run(self) -> None:
+        _ASYNC_NS.active = True
+        while True:
+            fn, handle = self._q.get()
+            try:
+                handle.value = fn()
+            except BaseException as err:  # noqa: BLE001 — crosses the thread
+                handle.error = err
+            handle.completed_at = time.perf_counter()
+            handle.done.set()
+
+
+_ASYNC_WORKER = _AsyncSyncWorker()
+
+
+def submit_async_round(fn: Callable[[], Any], label: str = "sync") -> AsyncSyncHandle:
+    """Run ``fn`` (one whole sync round) on the process-wide background sync
+    worker and return immediately with its :class:`AsyncSyncHandle`."""
+    return _ASYNC_WORKER.submit(fn, label)
+
 
 class Backend:
     """Protocol for metric-state synchronization."""
@@ -293,6 +391,12 @@ class Backend:
     #: byte-blob exchange (:meth:`all_gather_bytes`) instead of two
     #: collectives per state — the latency win on the KV-store DCN path.
     supports_packed: bool = False
+
+    #: eager backends whose collectives may run on the background sync worker
+    #: (``Metric.sync_async``): the transport must tolerate a collective
+    #: issued off the main thread — the KV-store path does via the dedicated
+    #: ``mtpu/aga`` sequence namespace, and a world-of-one trivially does.
+    supports_async: bool = False
 
     #: label set by the caller (the metric's per-state sync loop) so timeout
     #: diagnostics and telemetry can name the state being gathered
@@ -471,6 +575,7 @@ class MultihostBackend(Backend):
 
     supports_delta = True
     supports_packed = True
+    supports_async = True
 
     def __init__(self, options: Optional[SyncOptions] = None):
         self.options = options if options is not None else SyncOptions.from_env()
@@ -498,10 +603,14 @@ class MultihostBackend(Backend):
         """Stacked cross-process gather: returns ``(P,) + x.shape``."""
         x = jnp.asarray(x)
         label = self._label or "gather"
-        seq = next(_KV_SEQ)  # fixed per LOGICAL collective: retries reuse it
+        # fixed per LOGICAL collective (retries reuse it); the async sync
+        # worker draws from its own namespace so its collectives can never
+        # cross-match a concurrent main-thread gather's keys
+        ns, counter = _kv_namespace()
+        seq = next(counter)
         with _obs.span("sync.collective", backend=type(self).__name__, state=label):
             out = guarded_collective(
-                lambda: self._allgather(x, seq),
+                lambda: self._allgather(x, seq, ns),
                 self.options,
                 label=label,
                 telemetry=self._telemetry,
@@ -511,7 +620,7 @@ class MultihostBackend(Backend):
         self._telemetry["bytes_gathered"] = self._telemetry.get("bytes_gathered", 0) + int(nbytes)
         return out
 
-    def _allgather(self, x: Array, seq: int) -> Any:
+    def _allgather(self, x: Array, seq: int, ns: str = "mtpu/ag") -> Any:
         from jax.experimental import multihost_utils
 
         cls = MultihostBackend
@@ -526,7 +635,7 @@ class MultihostBackend(Backend):
                 cls._xla_collectives_broken = True
         if out is None:
             if cls._xla_collectives_broken:
-                out = self._kv_allgather(x, seq)
+                out = self._kv_allgather(x, seq, ns)
             else:
                 out = multihost_utils.process_allgather(x)
         # world-1 jobs: process_allgather returns the input unchanged, but
@@ -535,7 +644,7 @@ class MultihostBackend(Backend):
             out = np.asarray(out)[None]
         return out
 
-    def _kv_allgather(self, x: Array, seq: int) -> Any:
+    def _kv_allgather(self, x: Array, seq: int, ns: str = "mtpu/ag") -> Any:
         """Cross-process gather over the ``jax.distributed`` coordination
         service — the degraded transport for platforms whose XLA backend
         cannot launch multiprocess computations (CPU: "Multiprocess
@@ -563,7 +672,7 @@ class MultihostBackend(Backend):
         buf = io.BytesIO()
         np.save(buf, np.asarray(x), allow_pickle=False)
         try:
-            client.key_value_set_bytes(f"mtpu/ag/{seq}/{me}", buf.getvalue())
+            client.key_value_set_bytes(f"{ns}/{seq}/{me}", buf.getvalue())
         except Exception:
             pass  # retry of the same collective: our payload is already up
         # the guard owns timeout semantics; the store read only needs a
@@ -571,7 +680,7 @@ class MultihostBackend(Backend):
         backstop_ms = int(1000 * (self.options.timeout * 4 if self.options.timeout else 600.0))
         parts = [
             np.load(
-                io.BytesIO(_kv_get_bytes(client, f"mtpu/ag/{seq}/{r}", backstop_ms)),
+                io.BytesIO(_kv_get_bytes(client, f"{ns}/{seq}/{r}", backstop_ms)),
                 allow_pickle=False,
             )
             for r in range(world)
@@ -581,7 +690,7 @@ class MultihostBackend(Backend):
             # which required them to finish reading all seq-2 payloads —
             # nobody can still need ours
             try:
-                client.key_value_delete(f"mtpu/ag/{seq - 2}/{me}")
+                client.key_value_delete(f"{ns}/{seq - 2}/{me}")
             except Exception:
                 pass
         return np.stack(parts)
@@ -739,6 +848,7 @@ class LoopbackBackend(Backend):
 
     supports_delta = True
     supports_packed = True
+    supports_async = True
 
     def __init__(self, options: Optional[SyncOptions] = None):
         self.options = options if options is not None else SyncOptions.from_env()

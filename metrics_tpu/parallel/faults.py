@@ -17,6 +17,9 @@ them from a seeded deterministic schedule:
   (exercises retry/backoff).
 * ``desync`` — the pre-flight schema exchange sees a diverged peer
   (exercises :class:`SyncDesyncError` naming rank and state).
+* ``stall`` — EVERY collective sleeps for ``stall_secs`` (simulated DCN
+  round-trip latency; unlike ``delay`` it is recurring, not one-shot —
+  the knob behind the async-sync overlap benches).
 
 Faults are consumed one-shot: a retry of the same collective re-executes
 WITHOUT the fault, so ``schedule={0: "delay"}`` + ``max_retries=1`` is the
@@ -48,7 +51,7 @@ from metrics_tpu.utils.exceptions import SyncDesyncError, SyncError
 
 FaultSpec = Union[str, Tuple[str, Any]]
 
-_FAULT_KINDS = ("delay", "drop", "corrupt", "error", "desync")
+_FAULT_KINDS = ("delay", "drop", "corrupt", "error", "desync", "stall")
 _FAULT_EXCEPTION_MODES = ("chaos", "sync_error")
 
 
@@ -99,6 +102,9 @@ class ChaosBackend(Backend):
             (lets single-process CI exercise the multi-rank failure paths;
             collectives still return inner's local values).
         delay_secs / drop_secs: default durations for ``delay`` / ``drop``.
+        stall_secs: recurring per-collective latency — every collective
+            sleeps this long (simulated DCN RTT) unless a scheduled fault
+            already claimed its index.  ``0.0`` (default) disables it.
         options: guard options for the chaos layer itself when the inner
             backend has none (e.g. a NullBackend inner); a MultihostBackend
             inner keeps its own guard.
@@ -113,6 +119,7 @@ class ChaosBackend(Backend):
         world_size: Optional[int] = None,
         delay_secs: float = 0.05,
         drop_secs: float = 60.0,
+        stall_secs: float = 0.0,
         options: Optional[SyncOptions] = None,
         packed: Optional[bool] = None,
         fault_exception: str = "chaos",
@@ -138,6 +145,7 @@ class ChaosBackend(Backend):
         self._world = world_size
         self.delay_secs = delay_secs
         self.drop_secs = drop_secs
+        self.stall_secs = stall_secs
         self.options = options if options is not None else SyncOptions.from_env()
         self.op_index = 0
         self.injected: list = []  # (op_index, kind) log for assertions
@@ -158,7 +166,13 @@ class ChaosBackend(Backend):
                     fault = kind
                     break
         if fault is None:
-            return idx, None, None
+            if self.stall_secs > 0:
+                # recurring latency floor, NOT one-shot: every collective
+                # pays the simulated DCN round trip unless a scheduled
+                # fault already claimed this index
+                fault = ("stall", self.stall_secs)
+            else:
+                return idx, None, None
         kind, arg = (fault if isinstance(fault, tuple) else (fault, None))
         self.injected.append((idx, kind))
         _obs.counter_inc("chaos.faults", kind=kind)
@@ -193,6 +207,8 @@ class ChaosBackend(Backend):
             exc = ChaosInjectedSyncError if self.fault_exception == "sync_error" else ChaosInjectedError
             if k == "delay":
                 time.sleep(arg if arg is not None else self.delay_secs)
+            elif k == "stall":
+                time.sleep(arg if arg is not None else self.stall_secs)
             elif k == "drop":
                 self._drop_event.wait(arg if arg is not None else self.drop_secs)
                 raise exc(f"collective #{idx} ({op}) dropped by chaos schedule")
@@ -216,6 +232,13 @@ class ChaosBackend(Backend):
         # per-state delta slicing changes payload sizes but not the number or
         # order of collectives, so delegating keeps fault schedules stable
         return getattr(self.inner, "supports_delta", False)
+
+    @property
+    def supports_async(self) -> bool:  # type: ignore[override]
+        # chaos injection is thread-agnostic (sleeps and raises work the same
+        # on the background sync worker), so async eligibility is the inner
+        # backend's call
+        return getattr(self.inner, "supports_async", False)
 
     def is_distributed(self) -> bool:
         return self.inner.is_distributed() or (self._world or 1) > 1

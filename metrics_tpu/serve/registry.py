@@ -305,17 +305,34 @@ class MetricRegistry:
         if not self._jobs:
             raise MetricsTPUUserError("cannot checkpoint an empty registry")
         if self._ckpt_target is None:
-            self._ckpt_target = MetricCollection(
-                {name: job.metric for name, job in self._jobs.items()},
-                compute_groups=False,
-            )
+            # construction mutates member metrics once (pending-update flush
+            # + sync-policy stamping), so the one-time build takes the full
+            # sweep; every later call returns the cached collection lock-free
+            with self.locked():
+                if self._ckpt_target is None:
+                    self._ckpt_target = MetricCollection(
+                        {name: job.metric for name, job in self._jobs.items()},
+                        compute_groups=False,
+                    )
         return self._ckpt_target
 
     def locked(self) -> "_AllJobsLocked":
         """Context manager holding EVERY job lock (sorted by name, so the
         multi-lock sweep cannot deadlock against single-lock holders) — the
-        quiesce the durability loop wraps around checkpoint encode."""
+        full quiesce, now only needed for restore (which rewrites every
+        job's state in place)."""
         return _AllJobsLocked(self.jobs())
+
+    def lock_for_checkpoint_key(self, key: str) -> Any:
+        """The per-job lock for one :func:`flatten_target` checkpoint key
+        (``"col/{name}"``) — the ``lock_for`` hook of
+        :meth:`CheckpointManager.encode_target`, so a snapshot encode holds
+        one job lock at a time instead of quiescing the registry."""
+        from contextlib import nullcontext
+
+        name = key.split("/", 1)[1] if key.startswith("col/") else key
+        job = self._jobs.get(name)
+        return job.lock if job is not None else nullcontext()
 
 
 class _AllJobsLocked:

@@ -108,8 +108,12 @@ class EvalServer:
         self._started = True
         self._t0 = time.monotonic()
         if self.manager is not None and self.manager.latest_step() is not None:
+            # build the target OUTSIDE the sweep: its one-time construction
+            # takes the same sorted job locks, and nesting that inside
+            # locked() would witness reversed acquisition edges
+            target = self.registry.checkpoint_target()
             with self.registry.locked():
-                result = self.manager.restore(self.registry.checkpoint_target())
+                result = self.manager.restore(target)
             self.restored_step = self.last_checkpoint_step = result.step
             _obs.counter_inc("serve.restores")
         self._spawn("consumer", self.consumer.run)
@@ -176,7 +180,16 @@ class EvalServer:
 
     # ------------------------------------------------------------ durability
     def checkpoint_now(self, step: Optional[int] = None) -> int:
-        """Flush, quiesce every job, and commit one checkpoint."""
+        """Flush, encode each job under its own lock, commit lock-free.
+
+        The encode holds one brief per-job lock per metric (never the
+        registry-wide sweep) and the store writes + commit barrier run with
+        NO job lock held, so ``/query`` latency stays flat while a snapshot
+        is in flight.  The snapshot is per-job-consistent: each job's state
+        is internally coherent, but two jobs may be captured a few records
+        apart — the consistency restore actually needs, since every metric
+        restores independently.
+        """
         if self.manager is None:
             raise MetricsTPUUserError("EvalServer has no CheckpointManager")
         with self._ckpt_lock:
@@ -187,12 +200,14 @@ class EvalServer:
                 self.consumer.record_error(
                     "checkpoint flush timed out; snapshot misses buffered rows"
                 )
-            with self.registry.locked():
-                committed = self.manager.save_now(
-                    self.registry.checkpoint_target(), step=step
-                )
+            target = self.registry.checkpoint_target()
+            encoded = self.manager.encode_target(
+                target, lock_for=self.registry.lock_for_checkpoint_key
+            )
+            committed = self.manager.save_now(target, step=step, encoded=encoded)
             self.last_checkpoint_step = committed
         _obs.counter_inc("serve.checkpoints")
+        _obs.counter_inc("serve.nonblocking_snapshots")
         return committed
 
     def _durability_loop(self) -> None:

@@ -1,0 +1,45 @@
+# lock-order positives for the async-sync seam: 3 findings expected
+# (blocking-under-lock, blocking-callee-under-lock, inconsistent-order)
+#
+# Models the hazards the background sync worker introduces: holding a metric
+# RLock across a collective round couples the lock to a peer's progress, and
+# an ABBA between the worker-side lock and a metric RLock deadlocks the fold.
+import threading
+
+from metrics_tpu.parallel.backend import guarded_collective
+
+worker_lock = threading.Lock()  # sync-worker side
+metric_lock = threading.RLock()  # metric side
+
+
+class AsyncSyncUser:
+    def __init__(self, metric, options):
+        self.lock = threading.RLock()
+        self.metric = metric
+        self.options = options
+
+    def bad_round_under_lock(self):
+        with self.lock:
+            # blocking-under-lock: a whole collective round with the metric
+            # lock held — every reader stalls until the slowest peer answers
+            return guarded_collective(lambda: 1, self.options, label="bad")
+
+    def _drain(self):
+        # awaits the in-flight background round: this function blocks
+        self.metric.sync_async()
+
+    def bad_fold_under_lock(self):
+        with self.lock:
+            self._drain()  # blocking-callee-under-lock (one-hop propagation)
+
+
+def worker_side():
+    with worker_lock:
+        with metric_lock:  # edge worker_lock -> metric_lock
+            return 1
+
+
+def metric_side():
+    with metric_lock:
+        with worker_lock:  # edge metric_lock -> worker_lock: ABBA 2-cycle
+            return 2

@@ -57,10 +57,18 @@ import sys
 def _sync_exit(name: str) -> None:
     """Exit both ranks together: the first ``os._exit`` would kill the
     rank-0 coordination service and the survivor's error-polling thread
-    aborts the whole process (SIGABRT) — so rendezvous first, then exit."""
+    aborts the whole process (SIGABRT) — so rendezvous first, then exit.
+    Rank 0 (the service host) additionally lingers briefly: on a loaded
+    box a peer can be descheduled between the barrier returning and its
+    own ``os._exit``, and the error poller would still see the service
+    die in that window."""
+    import time
+
     from jax._src import distributed
 
     distributed.global_state.client.wait_at_barrier(name, 60_000)
+    if distributed.global_state.process_id == 0:
+        time.sleep(0.5)
     os._exit(0)
 
 
@@ -295,6 +303,52 @@ def _scenario_multistream(rank: int, nproc: int) -> None:
     print(f"DCN_MULTISTREAM_OK rank={rank}", flush=True)
 
 
+def _scenario_async(rank: int, nproc: int) -> None:
+    """Double-buffered async sync over a real two-process DCN link.
+
+    Each round's packed gather runs on the background worker (the isolated
+    ``mtpu/aga`` KV namespace) while the main thread keeps appending rows;
+    re-submitting folds the previous round into the delta cache, and the
+    catch-up barrier inside ``compute()`` makes the final value the full
+    union — identical to what a purely synchronous loop would produce.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tests.bases.dummies import DummyListMetric
+
+    def round_rows(r: int, step: int) -> np.ndarray:
+        return np.arange(r + 1, dtype=np.float32) + 100.0 * r + 10.0 * step
+
+    def union(upto_step: int) -> np.ndarray:
+        return np.concatenate(
+            [round_rows(r, s) for s in range(upto_step + 1) for r in range(nproc)]
+        )
+
+    m = DummyListMetric()  # autodetected MultihostBackend
+    rounds = 4
+    for step in range(rounds):
+        m.update(jnp.asarray(round_rows(rank, step)))
+        # no wait: the next submit's catch-up barrier is the only ordering
+        # point, so the gather genuinely overlaps the next update
+        handle = m.sync_async()
+        assert handle is not None, "MultihostBackend must be async-eligible"
+    val = np.asarray(m.compute())
+    np.testing.assert_allclose(np.sort(val), np.sort(union(rounds - 1)))
+    folds = [rep for rep in m.sync_report_history if rep.get("async")]
+    assert len(folds) >= rounds - 1, folds
+    assert all(rep["error"] is None for rep in folds), folds
+    # after round 1 seeds the cache, background rounds advance it: the
+    # final catch-up sync ships only the post-snapshot suffix
+    assert any(rep["delta"] for rep in folds), folds
+    assert m.last_sync_report["delta"] is True, m.last_sync_report
+    # unsync restored the local shard
+    assert not m._is_synced
+    local = np.concatenate([round_rows(rank, s) for s in range(rounds)])
+    np.testing.assert_allclose(np.concatenate([np.asarray(x) for x in m.x]), local)
+    print(f"DCN_ASYNC_OK rank={rank} folds={len(folds)}", flush=True)
+
+
 def _ckpt_collection():
     from metrics_tpu import CatMetric, MetricCollection
     from metrics_tpu.classification import Accuracy
@@ -436,6 +490,9 @@ def main() -> None:
         return
     if scenario == "delta":
         _scenario_delta(rank, nproc)
+        return
+    if scenario == "async":
+        _scenario_async(rank, nproc)
         return
     if scenario == "sketch":
         _scenario_sketch(rank, nproc)

@@ -1,0 +1,279 @@
+"""Asynchronous overlapped sync: equivalence, overlap, failure, kill switch.
+
+The contract under test: ``sync_async()`` kicks one packed sync round on the
+background worker and returns immediately; the delta cache's round/watermark
+token orders the fold-in; and the catch-up barrier inside ``sync`` /
+``compute`` makes the final value **bitwise identical** to a purely
+synchronous history — for every state kind (sum/mean/max/min/cat/sketch).
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.parallel import ChaosBackend, LoopbackBackend, NullBackend
+from metrics_tpu.streaming import StreamingQuantile
+
+from tests.bases.dummies import DummyListMetric
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    obs.disable()
+    yield
+    obs.reset()
+    obs.disable()
+
+
+def _bits(value):
+    """NaN-aware bit pattern of a computed value (float64 canonicalized)."""
+    return np.asarray(value, np.float64).tobytes()
+
+
+def _drive_async(m, batches):
+    """update + sync_async per step (every handle must be real), then compute."""
+    for batch in batches:
+        m.update(batch)
+        handle = m.sync_async()
+        assert handle is not None
+    return m.compute()
+
+
+FACTORIES = {
+    "sum": (SumMetric, lambda step: jnp.asarray(1.5 * step + 0.25)),
+    "mean": (MeanMetric, lambda step: jnp.asarray([step + 0.5, 2.0 * step])),
+    "max": (MaxMetric, lambda step: jnp.asarray(float(step % 3) - 1.0)),
+    "min": (MinMetric, lambda step: jnp.asarray(-float(step) / 3.0)),
+    "cat": (CatMetric, lambda step: jnp.arange(4.0) + 10.0 * step),
+    "cat_nan": (CatMetric, lambda step: jnp.asarray([step, np.nan, -step])),
+    "sketch": (StreamingQuantile, lambda step: jnp.arange(8.0) * (step + 1)),
+}
+
+
+class TestAsyncSyncEquivalence:
+    @pytest.mark.parametrize("kind", sorted(FACTORIES))
+    def test_bitwise_identical_to_synchronous(self, kind):
+        cls, make = FACTORIES[kind]
+        batches = [make(step) for step in range(4)]
+        async_val = _drive_async(cls(sync_backend=LoopbackBackend()), batches)
+        sync_m = cls(sync_backend=LoopbackBackend())
+        for batch in batches:
+            sync_m.update(batch)
+        assert _bits(async_val) == _bits(sync_m.compute())
+
+    def test_async_rounds_advance_the_delta_cache(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        for step in range(3):
+            m.update(jnp.arange(4.0) + step)
+            handle = m.sync_async()
+            assert handle is not None
+            handle.wait()
+            # re-submitting folds the completed round first: each background
+            # gather extends the prefix induction exactly like a sync round
+        m.sync_async().wait()
+        rep = m.last_sync_report
+        assert rep["async"] is True
+        assert rep["delta_round"] >= 2
+        # the catch-up sync inside compute ships only the suffix
+        m.compute()
+        assert m.last_sync_report["delta"] is True
+
+    def test_interleaved_async_and_sync_rounds(self):
+        # alternating sync_async / plain compute must keep the induction
+        # coherent (the catch-up folds before the synchronous gather)
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        twin = DummyListMetric(sync_backend=LoopbackBackend())
+        for step in range(4):
+            batch = jnp.arange(3.0) + 7.0 * step
+            m.update(batch)
+            twin.update(batch)
+            if step % 2 == 0:
+                assert m.sync_async() is not None
+            else:
+                m.compute()
+                m._computed = None
+            twin.compute()
+            twin._computed = None
+        assert _bits(m.compute()) == _bits(twin.compute())
+
+
+class TestOverlapAndCounters:
+    def test_submit_returns_promptly_under_stall(self):
+        chaos = ChaosBackend(LoopbackBackend(), packed=True, stall_secs=0.15)
+        m = CatMetric(sync_backend=chaos)
+        m.update(jnp.arange(8.0))
+        t0 = time.perf_counter()
+        handle = m.sync_async()
+        submit_secs = time.perf_counter() - t0
+        assert handle is not None
+        assert submit_secs < 0.1, f"submit blocked {submit_secs:.3f}s"
+        assert handle.wait(10.0)
+        m.update(jnp.arange(8.0) + 8.0)
+        m.compute()  # folds the round: overlap was the whole stalled gather
+        reports = list(m.sync_report_history)
+        fold = next(r for r in reports if r.get("async"))
+        assert fold["overlap_secs"] > 0.1
+        summary = obs.summarize_counters().get("sync", {})
+        assert summary.get("async_rounds", 0) >= 1
+        assert summary.get("overlap_secs", 0.0) > 0.1
+
+    def test_catchup_barrier_counts_when_round_is_slow(self):
+        chaos = ChaosBackend(LoopbackBackend(), packed=True, stall_secs=0.1)
+        m = CatMetric(sync_backend=chaos)
+        m.update(jnp.arange(4.0))
+        assert m.sync_async() is not None
+        m.compute()  # arrives before the stalled round completes: barrier
+        summary = obs.summarize_counters().get("sync", {})
+        assert summary.get("catchup_barriers", 0) >= 1
+
+    def test_counters_round_trip_through_prometheus(self):
+        m = CatMetric(sync_backend=LoopbackBackend())
+        m.update(jnp.arange(4.0))
+        assert m.sync_async() is not None
+        m.compute()
+        parsed = obs.parse_prometheus_text(obs.prometheus_text())
+        for field in ("async_rounds",):
+            prom = f"metrics_tpu_sync_{field}_total"
+            series = [v for (name, _), v in parsed.items() if name == prom]
+            assert series and sum(series) >= 1, prom
+        # overlap_secs stays float through the summary path
+        summary = obs.summarize_counters().get("sync", {})
+        assert isinstance(summary.get("overlap_secs", 0.0), float)
+
+
+class TestFailureSemantics:
+    def test_fault_during_async_falls_back_to_full_gather(self):
+        chaos = ChaosBackend(
+            LoopbackBackend(),
+            packed=True,
+            schedule={0: "error"},
+            fault_exception="sync_error",
+        )
+        m = DummyListMetric(sync_backend=chaos)
+        twin = DummyListMetric(sync_backend=LoopbackBackend())
+        batch = jnp.arange(5.0)
+        m.update(batch)
+        twin.update(batch)
+        handle = m.sync_async()
+        assert handle is not None
+        handle.wait()
+        assert handle.error is not None
+        value = m.compute()  # fold swallows the failure, then full-gathers
+        fold = next(r for r in m.sync_report_history if r.get("async"))
+        assert "ChaosInjectedSyncError" in fold["error"]
+        assert fold["fallback"] == "full_gather"
+        assert m.last_sync_report["delta"] is False  # cache was cleared
+        assert _bits(value) == _bits(twin.compute())
+
+    def test_reset_discards_stale_round(self):
+        m = DummyListMetric(sync_backend=LoopbackBackend())
+        m.update(jnp.arange(4.0))
+        handle = m.sync_async()
+        assert handle is not None
+        handle.wait()
+        m.reset()  # bumps the cache generation: the round is now stale
+        m.update(jnp.arange(2.0) + 100.0)
+        value = m.compute()
+        np.testing.assert_allclose(np.asarray(value), np.arange(2.0) + 100.0)
+        assert m.last_sync_report["delta"] is False
+
+    def test_worker_survives_a_failed_round(self):
+        # one poisoned round must not kill the shared worker thread
+        chaos = ChaosBackend(
+            LoopbackBackend(), packed=True, schedule={0: "error"},
+            fault_exception="sync_error",
+        )
+        bad = CatMetric(sync_backend=chaos)
+        bad.update(jnp.arange(3.0))
+        h1 = bad.sync_async()
+        assert h1 is not None and h1.wait(10.0)
+        good = CatMetric(sync_backend=LoopbackBackend())
+        good.update(jnp.arange(3.0))
+        h2 = good.sync_async()
+        assert h2 is not None and h2.wait(10.0)
+        assert h2.error is None
+
+
+class TestKillSwitch:
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_ASYNC_SYNC", "0")
+        m = CatMetric(sync_backend=LoopbackBackend())
+        assert m.async_sync is False
+        m.update(jnp.arange(3.0))
+        assert m.sync_async() is None
+
+    def test_kwarg_kill_switch(self):
+        m = CatMetric(sync_backend=LoopbackBackend(), async_sync=False)
+        m.update(jnp.arange(3.0))
+        assert m.sync_async() is None
+
+    def test_ineligible_backend_declines(self):
+        m = CatMetric(sync_backend=NullBackend())  # not distributed
+        m.update(jnp.arange(3.0))
+        assert m.sync_async() is None
+
+
+class TestForwardAsyncMode:
+    def test_forward_overlaps_and_compute_matches_sync(self):
+        lb = LoopbackBackend()
+        m = CatMetric(sync_backend=lb, dist_sync_on_step=True, async_sync=True)
+        twin = CatMetric(sync_backend=LoopbackBackend(), dist_sync_on_step=True)
+        for step in range(3):
+            batch = jnp.arange(4.0) + 10.0 * step
+            batch_val = m(batch)
+            twin(batch)
+            # async mode: the per-step value is the LOCAL batch value (the
+            # gather runs in the background and folds next step)
+            np.testing.assert_allclose(np.asarray(batch_val), np.asarray(batch))
+        assert _bits(m.compute()) == _bits(twin.compute())
+        summary = obs.summarize_counters().get("sync", {})
+        assert summary.get("async_rounds", 0) >= 1
+
+    def test_forward_stays_synchronous_without_optin(self):
+        m = CatMetric(sync_backend=LoopbackBackend(), dist_sync_on_step=True)
+        m(jnp.arange(3.0))
+        assert m._delta_cache.inflight is None
+        assert obs.summarize_counters().get("sync", {}).get("async_rounds", 0) == 0
+
+
+class TestCollections:
+    def test_collection_sync_async_returns_handles(self):
+        col = MetricCollection(
+            {
+                "cat": CatMetric(sync_backend=LoopbackBackend()),
+                "total": SumMetric(sync_backend=LoopbackBackend()),
+            }
+        )
+        col.update(jnp.arange(4.0))
+        handles = col.sync_async()
+        assert set(handles) == {"cat", "total"}
+        for handle in handles.values():
+            assert handle is None or handle.wait(10.0)
+        vals = col.compute()
+        twin = MetricCollection(
+            {
+                "cat": CatMetric(sync_backend=LoopbackBackend()),
+                "total": SumMetric(sync_backend=LoopbackBackend()),
+            }
+        )
+        twin.update(jnp.arange(4.0))
+        twin_vals = twin.compute()
+        for key in vals:
+            assert _bits(vals[key]) == _bits(twin_vals[key])
+
+    def test_aggregate_report_rolls_up_overlap(self):
+        chaos = ChaosBackend(LoopbackBackend(), packed=True, stall_secs=0.05)
+        col = MetricCollection({"cat": CatMetric(sync_backend=chaos)})
+        col.update(jnp.arange(4.0))
+        handles = col.sync_async()
+        assert handles["cat"] is not None
+        handles["cat"].wait(10.0)
+        col["cat"].sync_async().wait(10.0)  # folds the first round
+        totals = col.aggregate_sync_report()
+        assert totals["overlap_secs"] > 0.0

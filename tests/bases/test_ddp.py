@@ -181,6 +181,18 @@ def test_multihost_delta_sync_two_process():
 
 
 @pytest.mark.slow
+def test_multihost_async_sync_two_process():
+    """Real 2-process double-buffered async sync: every round's packed gather
+    runs on the background worker (isolated KV namespace) while the main
+    thread keeps appending; each re-submit folds the previous round into the
+    delta cache, and the catch-up barrier inside ``compute()`` lands both
+    ranks on the full union exactly as a synchronous loop would."""
+    for r, (code, out) in enumerate(_spawn_dcn_workers(scenario="async", timeout=120)):
+        assert code == 0, f"rank {r} failed:\n{out}"
+        assert f"DCN_ASYNC_OK rank={r}" in out
+
+
+@pytest.mark.slow
 def test_multihost_sketch_merge_two_process():
     """Real 2-process sketch sync: each rank folds a disjoint distribution
     into a ``StreamingQuantile`` KLL sketch; compute must gather and MERGE

@@ -248,6 +248,7 @@ class TestBackboneShapes:
         out = np.asarray(ext(imgs))
         assert out.shape == (2, dim)
 
+    @pytest.mark.slow  # per the policy above: "192" is the tier-1 representative
     def test_logits_tap(self):
         from metrics_tpu.image.backbones.inception import InceptionFeatureExtractor
 

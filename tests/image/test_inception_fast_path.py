@@ -56,6 +56,7 @@ def test_extractor_optimized_matches_reference_path():
     np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.slow  # fold-correctness is tier-1 above; the bf16 smoke rebuilds the backbone (~25s CPU)
 def test_extractor_optimized_bf16_runs():
     imgs = (np.random.default_rng(1).random((2, 3, 64, 64)) * 255).astype(np.uint8)
     fast = InceptionFeatureExtractor(

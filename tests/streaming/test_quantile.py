@@ -58,6 +58,9 @@ class TestStreamingQuantile:
         assert m.n_items == 0
         assert np.isnan(float(m.compute()))
 
+    @pytest.mark.slow  # 3x5k-item eager merge (~22s CPU); the merge path stays
+    # tier-1 via test_loopback_sync_hits_merge_path and the multistream
+    # elastic-merge test
     def test_merge_state_multi_way(self):
         rng = np.random.default_rng(1)
         shards = [rng.normal(loc=3.0 * i, size=5_000).astype(np.float32) for i in range(3)]

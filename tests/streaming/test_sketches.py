@@ -130,6 +130,9 @@ class TestKLL:
         assert traces["n"] == 1
         assert int(st["n"]) == 20 * 512
 
+    @pytest.mark.slow  # the vmapped slot-merge runs tier-1 for real inside
+    # test_window.py::test_windowed_sketch_rotation and the multistream
+    # vmap-equivalence suite; this kernel-level variant traces ~19s on CPU
     def test_merge_is_vmappable(self):
         """Stacked states merge under vmap (the WindowedMetric slot path)."""
         sts = [

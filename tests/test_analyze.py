@@ -129,6 +129,8 @@ FIXTURE_CASES = [
     ("trace_safety_neg.py", "trace-safety", 0, set()),
     ("lock_order_pos.py", "lock-order", 3,
      {"blocking-under-lock", "blocking-callee-under-lock", "inconsistent-order"}),
+    ("lock_order_async_pos.py", "lock-order", 3,
+     {"blocking-under-lock", "blocking-callee-under-lock", "inconsistent-order"}),
     ("lock_order_neg.py", "lock-order", 0, set()),
     ("state_contract_pos.py", "state-contract", 6,
      {"reduce-default", "list-state-reduce", "sketch-merge", "stackable-growing-state",

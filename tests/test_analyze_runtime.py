@@ -152,6 +152,42 @@ def test_exclusive_init_phase_is_not_a_race():
     assert _race_findings(log) == []
 
 
+def test_witness_accepts_async_sync_worker():
+    # the background sync thread must hold NO metric/job lock while parked
+    # on its queue or while running a round — a green witnessed run over a
+    # stall-injected async round is the dynamic proof
+    def workload():
+        import numpy as np
+
+        from metrics_tpu.aggregation import CatMetric
+        from metrics_tpu.parallel import ChaosBackend, LoopbackBackend
+        from metrics_tpu.serve.registry import EvalJob
+
+        chaos = ChaosBackend(LoopbackBackend(), packed=True, stall_secs=0.05)
+        job = EvalJob("async", CatMetric(sync_backend=chaos))
+        for i in range(3):
+            with job.lock:
+                job.metric.update(np.arange(4.0) + i)
+            handle = job.metric.sync_async()  # NOT under the job lock
+            assert handle is not None
+            handle.wait()
+        with job.lock:
+            np.asarray(job.metric.compute())
+
+    log = witnessed_run(workload, block_threshold=0.02)
+    worker_threads = {
+        rec[2] for rec in log.blocked if rec[2] == "mtpu-async-sync"
+    } | {
+        thread
+        for _, _, (_, _, thread), (_, _, thread2) in log.cycles()
+        for thread in (thread, thread2)
+        if thread == "mtpu-async-sync"
+    }
+    assert worker_threads == set(), worker_threads
+    assert [d for r, d in _lock_findings(log) if r != "witness-no-coverage"] == []
+    assert _race_findings(log) == []
+
+
 # ---------------------------------------------------------------------------
 # coverage sentinels: a rotted driver turns red, not vacuously green
 # ---------------------------------------------------------------------------

@@ -53,6 +53,13 @@ from tools.analyze.passes.serve_blocking import BLOCKING_CALLS as COLLECTIVE_CAL
 # attribute reads that look like sockets/HTTP: parked on a peer
 SOCKET_CALLS = {"urlopen", "recv", "accept", "connect", "sendall", "getresponse"}
 
+# the async-sync seam: these run (or synchronously wait on) whole collective
+# rounds, so calling them with a lock held couples that lock to the
+# background sync worker's progress — same hazard class as a raw collective.
+# Kept local to this pass: serve_blocking's vocabulary is about the HTTP
+# request path, which never touches the async seam.
+ASYNC_COLLECTIVE_CALLS = {"guarded_collective", "sync_async", "_async_catchup"}
+
 _SCRATCH = "lock-order"
 
 # call edges followed from a lock-held call site before the search gives up
@@ -99,6 +106,11 @@ def _blocking_reason(call: ast.Call, unit: ModuleUnit) -> Optional[str]:
     )
     if attr in COLLECTIVE_CALLS:
         return f"`{attr}(...)` blocks on peers (collective/barrier/KV/commit)"
+    if attr in ASYNC_COLLECTIVE_CALLS:
+        return (
+            f"`{attr}(...)` runs or awaits a sync round on the background "
+            "sync worker"
+        )
     if attr in SOCKET_CALLS:
         return f"`{attr}(...)` parks on a socket"
     if (
