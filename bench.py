@@ -1213,6 +1213,213 @@ def _bench_serve(n_records=30_000, block_rows=256, num_streams=256, n_queries=60
     return rate, profile
 
 
+def _bench_serve_fleet(
+    n_records=80_000,
+    block_rows=256,
+    num_streams=256,
+    ceiling_records=12_000,
+    batch_rows=2048,
+    n_queries=500,
+    widths=(1, 2, 4),
+):
+    """Config 11: the sharded serve fleet — columnar ingest + scatter-gather.
+
+    Prices the horizontal story: the same two jobs config 9 serves from one
+    worker, now span-partitioned across 1/2/4 in-process shard workers
+    behind a :class:`FleetCoordinator`.  Ingest pushes counter-keyed
+    columnar batches through the coordinator's ring staging (vectorized
+    partition -> per-shard forwarders -> ColumnBatch dispatches), flush
+    included, so the rate counts records *applied to metric state*.  The
+    comparison rate is the per-record single-worker ceiling — the config 9
+    submit loop, measured here on a smaller run — because that queue's
+    one-Python-object-per-record cost is exactly what the columnar wire
+    deletes.  Query latency is wall-clock HTTP against each frontend
+    (worker surface vs fleet scatter-gather surface), quiescent, so the
+    fleet number prices the fan-out + merge, not queue contention.
+    ``timed_recompiles`` sums jit traces over every timed window: the
+    per-shard block shapes are warmed first and must hold.
+    """
+    import threading
+    import urllib.request
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.multistream import MultiStreamMetric
+    from metrics_tpu.obs import counters_snapshot, summarize_counters
+    from metrics_tpu.serve import (
+        ColumnTraffic,
+        EvalServer,
+        FleetSpec,
+        JobSpec,
+        LocalFleet,
+        MetricRegistry,
+        ServeConfig,
+        make_fleet_http_server,
+        run_load,
+    )
+
+    rng = np.random.default_rng(11)
+    recompiles = 0
+    counters_before = counters_snapshot()
+
+    def _timed_jits(before):
+        return sum(
+            int(v - before.get(k, 0))
+            for k, v in counters_snapshot().items()
+            if k[0] == "jit_traces"
+        )
+
+    def _http_latencies(base, path, n):
+        lats = []
+        for _ in range(n):
+            q0 = time.perf_counter()
+            with urllib.request.urlopen(base + path, timeout=30.0) as resp:
+                resp.read()
+            lats.append(time.perf_counter() - q0)
+        return np.asarray(lats)
+
+    def _pct(lats, q):
+        # interpolated percentile over the full sample, not worst-of-N: the
+        # SLO claim must not hang on a single scheduler hiccup
+        return float(np.percentile(lats, q * 100.0))
+
+    # ---- per-record single-worker ceiling (the config 9 submit loop)
+    registry = MetricRegistry()
+    registry.register("mse", MeanSquaredError())
+    registry.register(
+        "per_tenant", MultiStreamMetric(MeanSquaredError(), num_streams=num_streams)
+    )
+    server = EvalServer(
+        registry,
+        # config 9's production config, verbatim — the interval flusher and
+        # per-record queue hops are exactly the costs the columnar wire deletes
+        ServeConfig(block_rows=block_rows, queue_capacity=65536, flush_interval=0.05),
+    ).start()
+    try:
+        preds = rng.uniform(size=ceiling_records).astype(np.float32)
+        target = rng.uniform(size=ceiling_records).astype(np.float32)
+        ids = rng.integers(0, num_streams, size=ceiling_records).astype(np.int32)
+        # warm every dispatch shape out of the window: a full block plus a
+        # block_rows-1 remainder covers each pow2 chunk of the plain job
+        for i in range(2 * block_rows - 1):
+            server.submit("mse", (preds[i], target[i]), timeout=5.0)
+            server.submit(
+                "per_tenant", (preds[i], target[i]), stream_id=int(ids[i]), timeout=5.0
+            )
+        server.flush()
+        base = f"http://127.0.0.1:{server.port}"
+        query_path = "/query?job=per_tenant&top_k=8"
+        _http_latencies(base, query_path, 10)
+        jit0 = counters_snapshot()
+        # median of three timed repeats: the 50ms interval flusher and the
+        # box's scheduler make any single window noisy
+        single_rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(ceiling_records):
+                tenants = bool(i & 1)
+                ok = server.submit(
+                    "per_tenant" if tenants else "mse",
+                    (preds[i], target[i]),
+                    stream_id=int(ids[i]) if tenants else None,
+                    timeout=5.0,
+                )
+                if not ok:
+                    raise RuntimeError(f"ceiling submit rejected at record {i}")
+            server.flush()
+            single_rates.append(ceiling_records / (time.perf_counter() - t0))
+        single_rps = float(np.median(single_rates))
+        single_lats = _http_latencies(base, query_path, n_queries)
+        recompiles += _timed_jits(jit0)
+    finally:
+        server.stop(final_checkpoint=False)
+
+    # ---- the fleet, at each width
+    profile = {
+        "records": n_records,
+        "block_rows": block_rows,
+        "num_streams": num_streams,
+        "single_worker_rps": round(single_rps, 1),
+        "single_worker_query_p50_ms": round(_pct(single_lats, 0.50) * 1e3, 3),
+        "single_worker_query_p99_ms": round(_pct(single_lats, 0.99) * 1e3, 3),
+    }
+    rate_w = {}
+    for w in widths:
+        spec = FleetSpec(
+            num_shards=w,
+            jobs=[
+                JobSpec("mse", MeanSquaredError, num_streams=None),
+                JobSpec("per_tenant", MeanSquaredError, num_streams=num_streams),
+            ],
+            server_config=ServeConfig(
+                block_rows=block_rows, queue_capacity=65536, flush_interval=3600.0
+            ),
+            # rings sized to the whole run: the bench prices throughput,
+            # not backpressure (rejects would silently shrink the work)
+            ring_capacity=n_records,
+        )
+        fleet = LocalFleet(spec).start()
+        frontend = make_fleet_http_server("127.0.0.1", 0, fleet.coordinator)
+        http_thread = threading.Thread(
+            target=lambda: frontend.serve_forever(poll_interval=0.1), daemon=True
+        )
+        http_thread.start()
+        try:
+            tenant_traffic = ColumnTraffic(
+                "per_tenant", arity=2, num_streams=num_streams, seed=11
+            )
+            mse_traffic = ColumnTraffic("mse", arity=2, seed=12)
+
+            def ingest(lo, hi):
+                cols, sids = tenant_traffic.batch(lo, hi)
+                a1, r1 = fleet.coordinator.ingest_columns("per_tenant", cols, sids)
+                cols2, _ = mse_traffic.batch(lo, hi)
+                a2, r2 = fleet.coordinator.ingest_columns("mse", cols2)
+                return a1 + a2, r1 + r2
+
+            # warm every shard's block shapes + the scatter-gather reads
+            ingest(0, 2 * block_rows * w - 1)
+            if not fleet.coordinator.flush(60.0):
+                raise RuntimeError("fleet warmup flush timed out")
+            fbase = f"http://127.0.0.1:{frontend.server_address[1]}"
+            _http_latencies(fbase, query_path, 10)
+            jit0 = counters_snapshot()
+            fleet_rates = []
+            for _ in range(3):  # median, mirroring the ceiling measurement
+                report = run_load(
+                    ingest,
+                    total_records=n_records // 2,  # each slot carries 2 records
+                    batch_rows=batch_rows,
+                    threads=1,
+                    flush=lambda: fleet.coordinator.flush(120.0),
+                )
+                if report.rejected or report.errors:
+                    raise RuntimeError(
+                        f"fleet load rejected {report.rejected} row(s): "
+                        f"{report.errors}"
+                    )
+                fleet_rates.append(report.accepted / report.elapsed_s)
+            fleet_lats = _http_latencies(fbase, query_path, n_queries)
+            recompiles += _timed_jits(jit0)
+            rate_w[w] = float(np.median(fleet_rates))
+            profile[f"ingest_rps_w{w}"] = round(rate_w[w], 1)
+            profile[f"query_p50_ms_w{w}"] = round(_pct(fleet_lats, 0.50) * 1e3, 3)
+            profile[f"query_p99_ms_w{w}"] = round(_pct(fleet_lats, 0.99) * 1e3, 3)
+        finally:
+            frontend.shutdown()
+            http_thread.join(timeout=5.0)
+            frontend.server_close()
+            fleet.stop()
+
+    top_width = max(widths)
+    profile["scaleup_vs_single_worker"] = round(rate_w[top_width] / single_rps, 2)
+    profile["timed_recompiles"] = recompiles
+    after = counters_snapshot()
+    profile["serve_counters"] = summarize_counters(
+        {k: v - counters_before.get(k, 0) for k, v in after.items()}
+    ).get("serve", {})
+    return rate_w[top_width], profile
+
+
 def _make_detection_batch_fixed(rng, batch_size, boxes_per_image=4):
     """Detection batch with a FIXED box count per image.
 
@@ -1702,6 +1909,7 @@ def main() -> None:
         ("config7_checkpoint_write_mb_per_sec", _bench_checkpoint),
         ("config8_multistream_samples_per_sec", _bench_multistream),
         ("config9_serve_ingest_records_per_sec", _bench_serve),
+        ("config11_serve_fleet_ingest_records_per_sec", _bench_serve_fleet),
         ("config10_mesh_ddp_samples_per_sec", _bench_mesh_ddp),
         ("device_mfu", _bench_mfu),
     ):
@@ -1809,6 +2017,24 @@ def main() -> None:
                     "eager_step_sync_ms"
                 ]
                 extra["config10_mesh_ddp_timed_recompiles"] = result[1]["timed_recompiles"]
+            elif name.startswith("config11_serve_fleet"):
+                extra[name] = round(result[0], 1)
+                extra["config11_serve_fleet_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) carries the horizontal-scaling proof per width
+                for key, val in (result[1].get("serve_counters") or {}).items():
+                    extra[f"config11_serve_fleet_{key}"] = val
+                for key in (
+                    "single_worker_rps",
+                    "scaleup_vs_single_worker",
+                    "timed_recompiles",
+                    "single_worker_query_p50_ms",
+                    "single_worker_query_p99_ms",
+                ):
+                    extra[f"config11_serve_fleet_{key}"] = result[1][key]
+                for key, val in result[1].items():
+                    if key.startswith(("ingest_rps_w", "query_p50_ms_w", "query_p99_ms_w")):
+                        extra[f"config11_serve_fleet_{key}"] = val
             elif name.startswith("config9_serve"):
                 extra[name] = round(result[0], 1)
                 extra["config9_serve_profile"] = result[1]

@@ -18,6 +18,7 @@ from metrics_tpu.checkpoint.manager import (
     CheckpointManager,
     RestoreResult,
     flatten_target,
+    shard_checkpoint_directory,
 )
 from metrics_tpu.checkpoint.store import ChaosStore, LocalStore
 from metrics_tpu.utils.exceptions import (
@@ -42,5 +43,6 @@ __all__ = [
     "decode_metric",
     "encode_metric",
     "flatten_target",
+    "shard_checkpoint_directory",
     "state_digest",
 ]

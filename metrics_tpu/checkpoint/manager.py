@@ -77,6 +77,22 @@ class EncodedTarget:
     manifest_schema: Dict[str, Any]
 
 
+def shard_checkpoint_directory(root: str, shard: int) -> str:
+    """Checkpoint root for ONE serve-fleet shard under a shared fleet root.
+
+    Each shard worker owns an independent manifest lineage (its own steps,
+    retention, and staleness clock), so a replacement worker for shard ``i``
+    restores exactly shard ``i``'s last committed state — the failover
+    contract of the sharded serve tier — and two shards can never tear each
+    other's commits.
+    """
+    import os
+
+    if int(shard) < 0:
+        raise ValueError(f"shard must be >= 0, got {shard}")
+    return os.path.join(str(root), f"shard_{int(shard):04d}")
+
+
 def _step_dir(step: int) -> str:
     return f"step_{step:08d}"
 

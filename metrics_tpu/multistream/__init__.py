@@ -13,6 +13,7 @@ on device so only ``k`` rows ever reach the host.  See
 from metrics_tpu.multistream.core import MultiStreamMetric
 from metrics_tpu.multistream.sharding import (
     replicate_sharding,
+    shard_spans,
     shard_streams,
     stream_mesh,
     stream_sharding,
@@ -20,6 +21,7 @@ from metrics_tpu.multistream.sharding import (
 
 __all__ = [
     "MultiStreamMetric",
+    "shard_spans",
     "shard_streams",
     "stream_mesh",
     "stream_sharding",

@@ -212,6 +212,12 @@ class MultiStreamMetric(Metric):
         self.jit_update = self.jit_update and base.jit_update
         self.jit_compute = self.jit_compute and base.jit_compute
         self._active_reported = 0
+        # compiled read-path programs keyed by (method, static args): the
+        # serve tier issues these queries at request rate, and the eager
+        # form (a fresh vmap trace + an elementwise op chain + a host pull
+        # per call) costs milliseconds of dispatch where the compiled
+        # program costs one executable launch
+        self._query_programs: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------ update
     def _check_update_inputs(
@@ -424,7 +430,9 @@ class MultiStreamMetric(Metric):
             return fn(self._state)
 
     def _report_active(self, state: Dict[str, Any]) -> None:
-        active = int(np.asarray(jnp.count_nonzero(state[self._ROWS_STATE])))
+        self._note_active(int(np.asarray(jnp.count_nonzero(state[self._ROWS_STATE]))))
+
+    def _note_active(self, active: int) -> None:
         if active > self._active_reported:
             _obs.counter_inc(
                 "multistream.streams_active",
@@ -433,17 +441,34 @@ class MultiStreamMetric(Metric):
             )
             self._active_reported = active
 
+    def _query_program(self, cache_key: Any, build: Callable) -> Callable:
+        """One compiled program per distinct read query (keyed by its static
+        parameters); jit's own cache handles argument-shape variation."""
+        prog = self._query_programs.get(cache_key)
+        if prog is None:
+            prog = jax.jit(build)
+            self._query_programs[cache_key] = prog
+        return prog
+
     def compute_streams(self, stream_ids: Any) -> Any:
         """Base values for just the given streams: gathers ``len(stream_ids)``
         state rows on device and computes only those — O(k), not O(S)."""
         ids = jnp.ravel(jnp.asarray(stream_ids)).astype(jnp.int32)
 
-        def query(state: Dict[str, Any]) -> Any:
-            self._report_active(state)
+        def query(state: Dict[str, Any], ids: Array) -> Any:
+            _obs.count_trace(type(self).__name__, "query")
             lane_state = {k: state[k][ids] for k in self._base_state_keys}
-            return jax.vmap(self._base.apply_compute)(lane_state)
+            values = jax.vmap(self._base.apply_compute)(lane_state)
+            return values, jnp.count_nonzero(state[self._ROWS_STATE])
 
-        return self._with_query_state(query)
+        def run(state: Dict[str, Any]) -> Any:
+            values, active = self._query_program(("compute_streams",), query)(
+                state, ids
+            )
+            self._note_active(int(np.asarray(active)))
+            return values
+
+        return self._with_query_state(run)
 
     def _stream_scores(self, state: Dict[str, Any], key: Any) -> Array:
         lane_state = {k: state[k] for k in self._base_state_keys}
@@ -478,17 +503,29 @@ class MultiStreamMetric(Metric):
             raise ValueError(f"k must be in [1, {self.num_streams}], got {k}")
         _obs.counter_inc("multistream.topk_queries", metric=type(self._base).__name__)
 
-        def query(state: Dict[str, Any]) -> Tuple[Array, Array]:
-            self._report_active(state)
+        def query(state: Dict[str, Any]) -> Tuple[Array, Array, Array]:
+            _obs.count_trace(type(self).__name__, "query")
             values = self._stream_scores(state, key)
             fill = -jnp.inf if largest else jnp.inf
             score = jnp.where(jnp.isnan(values), fill, values.astype(jnp.float32))
             if not largest:
                 score = -score
             _, idx = lax.top_k(score, k)
-            return values[idx], idx
+            return values[idx], idx, jnp.count_nonzero(state[self._ROWS_STATE])
 
-        return self._with_query_state(query)
+        try:  # dict `key` selectors may be unhashable; those stay eager
+            cache_key = ("top_k", k, key, bool(largest))
+            hash(cache_key)
+        except TypeError:
+            cache_key = None
+
+        def run(state: Dict[str, Any]) -> Tuple[Array, Array]:
+            prog = query if cache_key is None else self._query_program(cache_key, query)
+            values, idx, active = prog(state)
+            self._note_active(int(np.asarray(active)))
+            return values, idx
+
+        return self._with_query_state(run)
 
     def bottom_k(self, k: int, key: Any = None) -> Tuple[Array, Array]:
         """The ``k`` lowest-valued streams as ``(values, stream_ids)`` — see

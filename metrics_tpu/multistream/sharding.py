@@ -9,14 +9,48 @@ axis, ``NamedSharding(mesh, P('batch'))`` on the leading (stream) axis of
 every stacked state, and replication for the scalar bookkeeping states.
 """
 
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.multistream.core import MultiStreamMetric
 
-__all__ = ["stream_mesh", "stream_sharding", "replicate_sharding", "shard_streams"]
+__all__ = [
+    "stream_mesh",
+    "stream_sharding",
+    "replicate_sharding",
+    "shard_streams",
+    "shard_spans",
+]
+
+
+def shard_spans(num_streams: int, num_shards: int) -> List[Tuple[int, int]]:
+    """Contiguous, balanced half-open spans partitioning ``[0, num_streams)``.
+
+    Span ``i`` is the slice of the stream axis shard ``i`` owns in a
+    sharded serve fleet (or a device owns under :func:`shard_streams` when
+    the count divides evenly): the first ``num_streams % num_shards``
+    spans get the extra stream, sizes differ by at most one, and spans are
+    ascending — so a global stream id maps to ``(shard, id - lo)`` with
+    one comparison and the concatenation of per-shard results preserves
+    global stream order.
+    """
+    s, n = int(num_streams), int(num_shards)
+    if n < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if s < n:
+        raise ValueError(
+            f"cannot cut {s} stream(s) into {n} non-empty shard span(s)"
+        )
+    base, extra = divmod(s, n)
+    spans: List[Tuple[int, int]] = []
+    lo = 0
+    for i in range(n):
+        hi = lo + base + (1 if i < extra else 0)
+        spans.append((lo, hi))
+        lo = hi
+    return spans
 
 
 def stream_mesh(devices: Optional[Any] = None, axis_name: str = "batch") -> Mesh:

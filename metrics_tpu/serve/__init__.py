@@ -22,25 +22,71 @@ Quick start::
     # GET :9100/metrics  |  GET :9100/query?job=mse  |  GET :9100/healthz
     server.stop()        # drain + final checkpoint
 
-See ``docs/serving.md`` for the architecture and the soak/kill→restore
-drill that backs the durability claim.
+One server not enough?  The **sharded fleet** scales the same service
+horizontally: a :class:`ShardRouter` span-partitions every multistream
+job's stream axis across N workers (plain jobs place whole via a
+consistent-hash ring), a :class:`FleetCoordinator` frontend stages ingest
+in pre-allocated columnar rings and forwards per shard, scatter-gathers
+``top_k`` / ``where`` / ``compute`` with exact single-worker semantics,
+and fails a dead shard over to a replacement restored from its own
+checkpoint directory.  See ``docs/serving.md``.
+
 """
 
-from metrics_tpu.serve.ingest import BlockBatcher, IngestConsumer, IngestQueue, Record
+from metrics_tpu.serve.columnar import ColumnRing
+from metrics_tpu.serve.coordinator import (
+    FleetCoordinator,
+    HTTPShard,
+    make_fleet_http_server,
+)
+from metrics_tpu.serve.fleet import (
+    FleetSpec,
+    InProcessShard,
+    JobSpec,
+    LocalFleet,
+    build_shard_registry,
+)
+from metrics_tpu.serve.httpd import PooledHTTPServer
+from metrics_tpu.serve.loadgen import ColumnTraffic, LoadReport, run_load, run_process_load
+from metrics_tpu.serve.ingest import (
+    BlockBatcher,
+    ColumnBatch,
+    IngestConsumer,
+    IngestQueue,
+    Record,
+)
 from metrics_tpu.serve.registry import EvalJob, MetricRegistry
+from metrics_tpu.serve.router import HashRing, ShardRouter
 from metrics_tpu.serve.server import EvalServer, ServeConfig
 from metrics_tpu.serve.traffic import JobTraffic, TrafficGenerator, default_traffic
 
 __all__ = [
     "BlockBatcher",
+    "ColumnBatch",
+    "ColumnRing",
+    "ColumnTraffic",
+    "LoadReport",
     "EvalJob",
     "EvalServer",
+    "FleetCoordinator",
+    "FleetSpec",
+    "HTTPShard",
+    "HashRing",
+    "InProcessShard",
     "IngestConsumer",
     "IngestQueue",
+    "JobSpec",
     "JobTraffic",
+    "LocalFleet",
     "MetricRegistry",
+    "PooledHTTPServer",
     "Record",
     "ServeConfig",
+    "ShardRouter",
     "TrafficGenerator",
+    "build_shard_registry",
     "default_traffic",
+    "make_fleet_http_server",
+    "run_load",
+    "run_process_load",
 ]

@@ -1,0 +1,890 @@
+"""Fleet coordinator: one frontend over N shard workers.
+
+The coordinator owns no metric state.  It holds a
+:class:`~metrics_tpu.serve.router.ShardRouter` plus one opaque **handle**
+per shard and does three things:
+
+* **Parallel ingest** — request threads partition a batch's columns by
+  shard (one vectorized ``searchsorted``) and copy them into per-
+  ``(shard, job)`` :class:`~metrics_tpu.serve.columnar.ColumnRing`
+  staging; one forwarder thread per shard drains ring **views** and ships
+  them to its worker.  The HTTP thread never waits on a worker: a full
+  ring is a counted rejection (backpressure), a dead worker just leaves
+  rows parked in its ring until failover replaces the handle.
+* **Scatter-gather queries** — ``top_k`` / ``where`` / ``compute`` fan
+  out to every shard through a thread pool with **timed** result waits
+  and merge on the coordinator.  Each worker already ranked its own span
+  on device (``lax.top_k``), so the merge is O(k * num_shards) host work;
+  contiguous ascending spans make the merged ranking bitwise identical to
+  a single worker over the union of streams (ties break lowest-global-id
+  first, NaNs sort as ``-inf``/``+inf`` exactly like the device kernel).
+* **Liveness + failover** — ``health()`` rolls up per-shard probes (an
+  unreachable worker marks the fleet degraded; the HTTP ``/healthz``
+  answers 503) and ``failover(shard)`` swaps in a replacement handle from
+  the injected ``respawn`` callback (the fleet layer restores the dead
+  shard's checkpoint before handing the handle back).
+
+Shard handles are **duck-typed** on purpose: the coordinator never
+imports or constructs worker machinery, so ``tools/analyze``'s
+serve-blocking and lock-order passes check this whole module with no
+opt-outs — nothing on a request thread may block, and nothing here does.
+A handle provides::
+
+    ingest_columns(job, cols, stream_ids=None) -> bool
+    ingest_rows(job, rows)                     -> (accepted, rejected)
+    compute(job)                               -> jsonable
+    compute_streams(job, local_ids)            -> jsonable list
+    top_k(job, k, key, largest)                -> (values, local_ids)
+    where(job, op, threshold, k, key)          -> (local_ids, total)
+    health()                                   -> dict
+    flush(timeout)                             -> bool
+
+:class:`HTTPShard` (below) speaks that protocol to a remote worker's
+HTTP surface; the in-process equivalent lives in
+``metrics_tpu.serve.fleet``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+from urllib.error import HTTPError, URLError
+from urllib.parse import parse_qs, urlparse
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from metrics_tpu.obs import core as _obs
+from metrics_tpu.obs.exporters import prometheus_text
+from metrics_tpu.serve.columnar import ColumnRing
+from metrics_tpu.serve.httpd import _MAX_INGEST_BYTES, PooledHTTPServer
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = [
+    "HTTPShard",
+    "FleetCoordinator",
+    "FleetHTTPServer",
+    "make_fleet_http_server",
+]
+
+_FORWARD_POLL_S = 0.005  # forwarder idle poll (timed waits only)
+_FORWARD_IDLE_MAX_S = 0.08  # idle backoff cap: keeps N sleeping forwarders
+# from preempting request threads every few ms on small hosts
+
+
+def _is_scalar(value: Any) -> bool:
+    return isinstance(value, (int, float)) and not isinstance(value, bool)
+
+
+class HTTPShard:
+    """Handle over one remote shard worker's HTTP surface.
+
+    Every method is one request/response with a bounded socket timeout;
+    nothing here takes a lock, so the lock-order pass has nothing to
+    order.  Ingest serializes ring views straight onto the columnar wire
+    (``POST /ingest_columns``) — the worker reconstructs the columns with
+    ``np.frombuffer``; no per-record objects on either side.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+        self.base = f"http://{host}:{int(port)}"
+        self.timeout = float(timeout)
+
+    # ------------------------------------------------------------- plumbing
+    def _get(self, path: str) -> Dict[str, Any]:
+        with urlopen(self.base + path, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def _post(self, path: str, body: bytes, content_type: str) -> Tuple[int, Dict[str, Any]]:
+        req = Request(
+            self.base + path,
+            data=body,
+            headers={"Content-Type": content_type},
+            method="POST",
+        )
+        try:
+            with urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except HTTPError as err:
+            raw = err.read()
+            try:
+                payload = json.loads(raw.decode()) if raw else {}
+            except ValueError:
+                payload = {}
+            return err.code, payload
+
+    # --------------------------------------------------------------- ingest
+    def ingest_columns(
+        self,
+        job: str,
+        cols: Sequence[np.ndarray],
+        stream_ids: Optional[np.ndarray] = None,
+    ) -> bool:
+        cols = [np.ascontiguousarray(c) for c in cols]
+        header = {
+            "job": job,
+            "rows": int(cols[0].shape[0]),
+            "arity": len(cols),
+            "dtype": cols[0].dtype.str,
+            "ids": stream_ids is not None,
+        }
+        parts = [json.dumps(header).encode(), b"\n"]
+        parts.extend(c.tobytes() for c in cols)
+        if stream_ids is not None:
+            parts.append(np.ascontiguousarray(stream_ids, dtype="<i4").tobytes())
+        status, _ = self._post(
+            "/ingest_columns", b"".join(parts), "application/octet-stream"
+        )
+        return status == 200
+
+    def ingest_rows(
+        self, job: str, rows: Sequence[Tuple[Tuple[Any, ...], Optional[int]]]
+    ) -> Tuple[int, int]:
+        records = [
+            {"values": list(values)}
+            if stream_id is None
+            else {"values": list(values), "stream_id": int(stream_id)}
+            for values, stream_id in rows
+        ]
+        status, payload = self._post(
+            "/ingest",
+            json.dumps({"job": job, "records": records}).encode(),
+            "application/json",
+        )
+        if status not in (200, 429):
+            raise MetricsTPUUserError(
+                f"shard {self.base} rejected ingest: HTTP {status} {payload}"
+            )
+        return int(payload.get("accepted", 0)), int(payload.get("rejected", 0))
+
+    # ---------------------------------------------------------------- reads
+    def compute(self, job: str) -> Any:
+        return self._get(f"/query?job={job}")["value"]
+
+    def compute_streams(self, job: str, local_ids: Sequence[int]) -> List[Any]:
+        ids = ",".join(str(int(i)) for i in local_ids)
+        return self._get(f"/query?job={job}&streams={ids}")["values"]
+
+    def top_k(
+        self, job: str, k: int, key: Any = None, largest: bool = True
+    ) -> Tuple[List[float], List[int]]:
+        path = f"/query?job={job}&top_k={int(k)}&largest={1 if largest else 0}"
+        if key is not None:
+            path += f"&key={key}"
+        out = self._get(path)
+        return out["top_k"], out["stream_ids"]
+
+    def where(
+        self, job: str, op: str, threshold: float, k: int, key: Any = None
+    ) -> Tuple[List[int], int]:
+        path = f"/query?job={job}&where={op}:{threshold!r}&k={int(k)}"
+        if key is not None:
+            path += f"&key={key}"
+        out = self._get(path)
+        return out["stream_ids"], int(out["total_matches"])
+
+    # ------------------------------------------------------------ liveness
+    def health(self) -> Dict[str, Any]:
+        try:
+            return self._get("/healthz")
+        except HTTPError as err:  # worker answered 503 with a JSON body
+            raw = err.read()
+            try:
+                return json.loads(raw.decode())
+            except ValueError:
+                return {"status": f"http_{err.code}"}
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        status, payload = self._post(
+            f"/flush?timeout={float(timeout)!r}", b"{}", "application/json"
+        )
+        return status == 200 and bool(payload.get("flushed"))
+
+    def checkpoint(self) -> int:
+        status, payload = self._post("/checkpoint", b"{}", "application/json")
+        if status != 200:
+            raise MetricsTPUUserError(
+                f"shard {self.base} checkpoint failed: HTTP {status} {payload}"
+            )
+        return int(payload["step"])
+
+
+class FleetCoordinator:
+    """Routes ingest to shard rings and merges scatter-gather reads.
+
+    Args:
+        router: a built :class:`~metrics_tpu.serve.router.ShardRouter`.
+        handles: one shard handle per shard, index-aligned with the
+            router's shard ids (see the module docstring for the duck
+            protocol).
+        respawn: optional ``shard -> handle`` callback used by
+            :meth:`failover`; the callback owns restoring the dead
+            shard's checkpoint before returning the replacement.
+        ring_capacity: rows per ``(shard, job)`` staging ring.
+        ingest_dtype: dtype scalar JSON records are staged at (the
+            columnar hot path; float32 halves the wire for serving).
+        query_timeout: per-shard bound on every scatter-gather wait.
+    """
+
+    def __init__(
+        self,
+        router: Any,
+        handles: Sequence[Any],
+        respawn: Optional[Callable[[int], Any]] = None,
+        ring_capacity: int = 8192,
+        ingest_dtype: Any = np.float32,
+        query_timeout: float = 30.0,
+    ) -> None:
+        if len(handles) != router.num_shards:
+            raise MetricsTPUUserError(
+                f"router expects {router.num_shards} shard(s), "
+                f"got {len(handles)} handle(s)"
+            )
+        self.router = router
+        self._handles: List[Any] = list(handles)
+        self._respawn = respawn
+        self.ring_capacity = int(ring_capacity)
+        self.ingest_dtype = np.dtype(ingest_dtype)
+        self.query_timeout = float(query_timeout)
+        self._rings: Dict[Tuple[int, str], ColumnRing] = {}
+        self._rings_lock = threading.Lock()
+        try:  # named in the runtime lock-witness graph
+            self._rings_lock.witness_name = "FleetCoordinator._rings_lock"
+        except AttributeError:
+            pass
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, len(self._handles)),
+            thread_name_prefix="fleet-scatter",
+        )
+        self._stop = threading.Event()
+        self._forwarders: List[threading.Thread] = []
+        self._started = False
+
+    # ---------------------------------------------------------------- lifecycle
+    @property
+    def num_shards(self) -> int:
+        return len(self._handles)
+
+    def handle(self, shard: int) -> Any:
+        return self._handles[int(shard)]
+
+    def start(self) -> "FleetCoordinator":
+        """Spawn one forwarder thread per shard (idempotent)."""
+        if self._started:
+            return self
+        self._started = True
+        for shard in range(self.num_shards):
+            t = threading.Thread(
+                target=self._forward_loop,
+                args=(shard,),
+                name=f"fleet-forward-{shard}",
+                daemon=True,
+            )
+            t.start()
+            self._forwarders.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._forwarders:
+            t.join(timeout=5.0)
+        self._forwarders = []
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------ ingest
+    def _ring(self, shard: int, job: str, arity: int, with_ids: bool) -> ColumnRing:
+        key = (int(shard), job)
+        ring = self._rings.get(key)
+        if ring is not None:
+            return ring
+        with self._rings_lock:
+            ring = self._rings.get(key)
+            if ring is None:
+                ring = ColumnRing(
+                    arity,
+                    capacity=self.ring_capacity,
+                    with_ids=with_ids,
+                    dtype=self.ingest_dtype,
+                )
+                self._rings[key] = ring
+            return ring
+
+    def _shard_rings(self, shard: int) -> List[Tuple[str, ColumnRing]]:
+        # dict reads race benignly with _ring() inserts (GIL-atomic); a
+        # ring missed this pass is drained on the next poll
+        return [(job, r) for (s, job), r in list(self._rings.items()) if s == shard]
+
+    def ingest_columns(
+        self,
+        job: str,
+        cols: Sequence[np.ndarray],
+        stream_ids: Optional[np.ndarray] = None,
+    ) -> Tuple[int, int]:
+        """Partition one columnar batch across shard rings.
+
+        Returns ``(accepted, rejected)`` row counts; a rejection means the
+        target shard's ring was full (its worker is slow or dead) — the
+        caller sees backpressure immediately instead of queueing unbounded.
+        """
+        cols = [np.asarray(c).reshape(-1) for c in cols]
+        n = int(cols[0].shape[0]) if cols else 0
+        if n == 0:
+            return 0, 0
+        if self.router.is_multistream(job):
+            if stream_ids is None:
+                raise MetricsTPUUserError(
+                    f"job {job!r} is multistream; ingest needs stream_ids"
+                )
+            parts = self.router.partition_ids(job, stream_ids)
+            accepted = rejected = 0
+            for shard, (positions, local_ids) in parts.items():
+                ring = self._ring(shard, job, len(cols), with_ids=True)
+                ok = ring.put([c[positions] for c in cols], local_ids)
+                if ok:
+                    accepted += int(positions.shape[0])
+                else:
+                    rejected += int(positions.shape[0])
+            return accepted, rejected
+        shard = self.router.owner(job)
+        ring = self._ring(shard, job, len(cols), with_ids=False)
+        ok = ring.put(cols, None)
+        return (n, 0) if ok else (0, n)
+
+    def ingest_records(
+        self, job: str, records: Sequence[Tuple[Tuple[Any, ...], Optional[int]]]
+    ) -> Tuple[int, int]:
+        """Ingest parsed ``(values, stream_id)`` records.
+
+        Scalar rows take the columnar hot path (one transpose into numpy,
+        then :meth:`ingest_columns`); rows with array-valued fields fall
+        back to per-shard record forwarding through ``handle.ingest_rows``.
+        """
+        if not records:
+            return 0, 0
+        multistream = self.router.is_multistream(job)
+        scalar = all(
+            all(_is_scalar(v) for v in values) for values, _sid in records
+        )
+        same_arity = len({len(values) for values, _sid in records}) == 1
+        if multistream:
+            missing = sum(1 for _v, sid in records if sid is None)
+            if missing:
+                _obs.counter_inc(
+                    "serve.records_rejected", missing, reason="no_stream_id"
+                )
+                records = [r for r in records if r[1] is not None]
+                if not records:
+                    return 0, missing
+        else:
+            missing = 0
+        accepted = rejected = 0
+        if scalar and same_arity:
+            arity = len(records[0][0])
+            vals = np.asarray(
+                [values for values, _sid in records], self.ingest_dtype
+            )
+            cols = [vals[:, i] for i in range(arity)]
+            ids = (
+                np.asarray([sid for _v, sid in records], np.int64)
+                if multistream
+                else None
+            )
+            accepted, rejected = self.ingest_columns(job, cols, ids)
+            return accepted, rejected + missing
+        # slow path: nested array values keep per-record framing
+        by_shard: Dict[int, List[Tuple[Tuple[Any, ...], Optional[int]]]] = {}
+        for values, sid in records:
+            if multistream:
+                shard, local = self.router.local_id(job, int(sid))
+                by_shard.setdefault(shard, []).append((values, local))
+            else:
+                by_shard.setdefault(self.router.owner(job), []).append(
+                    (values, None)
+                )
+        for shard, rows in by_shard.items():
+            try:
+                got, lost = self._handles[shard].ingest_rows(job, rows)
+            except (OSError, URLError, MetricsTPUUserError):
+                _obs.counter_inc(
+                    "serve.fleet_forward_errors", shard=str(shard)
+                )
+                got, lost = 0, len(rows)
+            accepted += got
+            rejected += lost
+        return accepted, rejected + missing
+
+    def _forward_loop(self, shard: int) -> None:
+        """Drain this shard's rings and ship views to the worker.
+
+        A worker that rejects (429) or errors leaves the rows parked in
+        the ring — ``commit(0)`` releases the drain without consuming, so
+        the same rows retry after backoff (and survive a failover: the
+        replacement handle picks them up on the next pass).
+
+        Idle waits back off geometrically (5ms up to 80ms): a quiescent
+        fleet must not have N forwarder threads waking every few
+        milliseconds and stealing scheduler slices from query threads;
+        the first batch after an idle stretch waits at most the cap,
+        which forwarding (asynchronous by design) absorbs.
+        """
+        idle_wait = _FORWARD_POLL_S
+        while not self._stop.is_set():
+            moved = False
+            for job, ring in self._shard_rings(shard):
+                got = ring.drain(timeout=0.0)
+                if got is None:
+                    continue
+                views, id_view, n = got
+                try:
+                    ok = self._handles[shard].ingest_columns(job, views, id_view)
+                except (OSError, URLError):
+                    ok = False
+                if ok:
+                    ring.commit(n)
+                    _obs.counter_inc(
+                        "serve.fleet_rows_forwarded", n, shard=str(shard)
+                    )
+                    moved = True
+                else:
+                    ring.commit(0)
+                    _obs.counter_inc(
+                        "serve.fleet_forward_errors", shard=str(shard)
+                    )
+            if moved:
+                idle_wait = _FORWARD_POLL_S
+            else:
+                self._stop.wait(idle_wait)
+                idle_wait = min(idle_wait * 2, _FORWARD_IDLE_MAX_S)
+
+    def staged_rows(self) -> int:
+        """Rows parked in staging rings, not yet on a worker."""
+        return sum(r.depth() for r in list(self._rings.values()))
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait for staging rings to drain, then flush every worker."""
+        deadline = time.monotonic() + float(timeout)
+        while self.staged_rows() > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(_FORWARD_POLL_S)
+        remaining = max(0.1, deadline - time.monotonic())
+        results = self._scatter(
+            "flush", lambda s, h: h.flush(remaining), count=False
+        )
+        return all(bool(ok) for ok in results.values())
+
+    # ----------------------------------------------------------- scatter-gather
+    def _scatter(
+        self,
+        what: str,
+        fn: Callable[[int, Any], Any],
+        count: bool = True,
+    ) -> Dict[int, Any]:
+        if count:
+            _obs.counter_inc("serve.scatter_queries", op=what)
+        futures = {
+            s: self._pool.submit(fn, s, self._handles[s])
+            for s in range(self.num_shards)
+        }
+        return {
+            s: f.result(timeout=self.query_timeout) for s, f in futures.items()
+        }
+
+    def top_k(
+        self, job: str, k: int, key: Any = None, largest: bool = True
+    ) -> Tuple[List[float], List[int]]:
+        """Global top-k over the union of streams: per-shard device top-k,
+        merged O(k * num_shards) on the host.
+
+        Exactness: the global top-k is a subset of the union of local
+        top-ks (each shard returns min(k, span_width) candidates), and the
+        merge ranks by ``(score, global_id)`` with NaN scored as the
+        device kernel scores it — so the result is the single-worker
+        ranking, ties and all.
+        """
+        k = int(k)
+        total = self.router.num_streams(job)
+        if not 1 <= k <= total:
+            raise MetricsTPUUserError(
+                f"top_k k must be in [1, {total}], got {k}"
+            )
+        per = self._scatter(
+            "top_k",
+            lambda s, h: h.top_k(
+                job,
+                min(k, self.router.span_width(job, s)),
+                key=key,
+                largest=largest,
+            ),
+        )
+        fill = -math.inf if largest else math.inf
+        candidates: List[Tuple[float, int, float]] = []
+        for shard, (values, local_ids) in per.items():
+            lo, _hi = self.router.span(job, shard)
+            for value, local in zip(values, local_ids):
+                value = float(value)
+                score = fill if math.isnan(value) else value
+                candidates.append((score, lo + int(local), value))
+        candidates.sort(
+            key=lambda c: ((-c[0] if largest else c[0]), c[1])
+        )
+        top = candidates[:k]
+        return [v for _s, _g, v in top], [g for _s, g, _v in top]
+
+    def where(
+        self, job: str, op: str, threshold: float, k: int, key: Any = None
+    ) -> Tuple[List[int], int]:
+        """First-k matching global stream ids + total match count.
+
+        Per-shard ids ascend within ascending spans, so concatenating in
+        shard order IS global ascending order — same ids, same order, as
+        one worker over the whole axis.
+        """
+        k = int(k)
+        per = self._scatter(
+            "where",
+            lambda s, h: h.where(
+                job,
+                op,
+                threshold,
+                min(k, self.router.span_width(job, s)),
+                key=key,
+            ),
+        )
+        gids: List[int] = []
+        total = 0
+        for shard in sorted(per):
+            local_ids, matches = per[shard]
+            lo, _hi = self.router.span(job, shard)
+            gids.extend(lo + int(i) for i in local_ids)
+            total += int(matches)
+        return gids[:k], total
+
+    def compute(self, job: str) -> Any:
+        """One job's full value: owner read (plain) or span concat
+        (multistream) — the stream axis reassembles in global order."""
+        if not self.router.is_multistream(job):
+            owner = self.router.owner(job)
+            _obs.counter_inc("serve.scatter_queries", op="compute")
+            return self._handles[owner].compute(job)
+        per = self._scatter("compute", lambda s, h: h.compute(job))
+        return _concat_streams([per[s] for s in sorted(per)])
+
+    def compute_streams(self, job: str, stream_ids: Sequence[int]) -> List[Any]:
+        """Per-stream reads reassembled in the caller's input order."""
+        total = self.router.num_streams(job)
+        ids = np.asarray([int(i) for i in stream_ids], np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= total):
+            raise MetricsTPUUserError(
+                f"stream ids must be in [0, {total}), got "
+                f"{[int(i) for i in ids if i < 0 or i >= total]}"
+            )
+        _obs.counter_inc("serve.scatter_queries", op="compute_streams")
+        parts = self.router.partition_ids(job, ids)
+        futures = {
+            s: self._pool.submit(
+                self._handles[s].compute_streams, job, [int(i) for i in local]
+            )
+            for s, (_pos, local) in parts.items()
+        }
+        out: List[Any] = [None] * int(ids.shape[0])
+        for s, (positions, _local) in parts.items():
+            values = futures[s].result(timeout=self.query_timeout)
+            for position, value in zip(positions, values):
+                out[int(position)] = value
+        return out
+
+    def compute_all(self) -> Dict[str, Any]:
+        """Every routed job's value, shards merged (the fleet-wide answer
+        the failover drill compares bitwise)."""
+        return {job: self.compute(job) for job in self.router.jobs()}
+
+    # --------------------------------------------------------------- liveness
+    def health(self) -> Dict[str, Any]:
+        """Per-shard probe rollup; ``status`` is ``"serving"`` only when
+        every shard is."""
+        futures = {
+            s: self._pool.submit(self._handles[s].health)
+            for s in range(self.num_shards)
+        }
+        shards: List[Dict[str, Any]] = []
+        for s in range(self.num_shards):
+            try:
+                info = futures[s].result(timeout=self.query_timeout)
+            except Exception as err:  # noqa: BLE001 — a dead worker is data, not a crash
+                info = {"status": "unreachable", "error": str(err)}
+            shards.append(dict(info, shard=s))
+        dead = [s for s, info in enumerate(shards) if info.get("status") != "serving"]
+        return {
+            "status": "serving" if not dead else "degraded",
+            "num_shards": self.num_shards,
+            "dead_shards": dead,
+            "staged_rows": self.staged_rows(),
+            "shards": shards,
+        }
+
+    def failover(self, shard: int) -> Any:
+        """Replace a dead shard's handle via the ``respawn`` callback.
+
+        The callback restores the shard's latest checkpoint into a fresh
+        worker and returns its handle; rows parked in the shard's staging
+        rings then drain to the replacement automatically.
+        """
+        if self._respawn is None:
+            raise MetricsTPUUserError(
+                "failover needs a respawn callback; construct the "
+                "coordinator with respawn=..."
+            )
+        shard = int(shard)
+        if not 0 <= shard < self.num_shards:
+            raise MetricsTPUUserError(
+                f"shard must be in [0, {self.num_shards}), got {shard}"
+            )
+        replacement = self._respawn(shard)
+        self._handles[shard] = replacement
+        _obs.counter_inc("serve.failovers", shard=str(shard))
+        return replacement
+
+
+def _concat_streams(parts: List[Any]) -> Any:
+    """Concatenate per-shard jsonable computes along the stream axis.
+
+    Shards return either a list (stacked per-stream values) or a dict of
+    such lists (structured computes); spans are contiguous and ascending,
+    so plain concatenation in shard order reassembles global stream order.
+    """
+    if not parts:
+        return []
+    if isinstance(parts[0], dict):
+        return {
+            key: _concat_streams([p[key] for p in parts]) for key in parts[0]
+        }
+    out: List[Any] = []
+    for p in parts:
+        out.extend(p)
+    return out
+
+
+# --------------------------------------------------------------------------
+# HTTP frontend
+# --------------------------------------------------------------------------
+
+
+class FleetHTTPServer(PooledHTTPServer):
+    """Pooled HTTP server carrying the owning coordinator reference."""
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        coordinator: FleetCoordinator,
+        pool_threads: int = 8,
+        backlog: int = 64,
+    ) -> None:
+        super().__init__(
+            address, _FleetHandler, pool_threads=pool_threads, backlog=backlog
+        )
+        self.coordinator = coordinator
+
+
+def make_fleet_http_server(
+    host: str,
+    port: int,
+    coordinator: FleetCoordinator,
+    pool_threads: int = 8,
+    backlog: int = 64,
+) -> FleetHTTPServer:
+    """Bind the coordinator's HTTP frontend; ``port=0`` picks a port."""
+    return FleetHTTPServer(
+        (host, port), coordinator, pool_threads=pool_threads, backlog=backlog
+    )
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    """The coordinator's HTTP surface — same routes as a worker, answered
+    by scatter-gather instead of local state.
+
+    * ``GET /healthz`` — per-shard liveness rollup; any dead shard is a
+      503 (load balancers stop routing a fleet that cannot answer for
+      every span).
+    * ``GET /metrics`` — the coordinator process's runtime counters
+      (``serve.shard_routes``, ``serve.scatter_queries``, ...); metric
+      *values* are scraped from the workers, which own the state.
+    * ``GET /query`` — ``?job=`` (merged compute), ``&streams=``,
+      ``&top_k=``, ``&where=`` — merged exactly as a single worker would
+      answer over the union of streams.
+    * ``GET /compute_all`` — every job, merged (the failover drill's
+      comparison read).
+    * ``POST /ingest`` — worker-compatible JSON records, partitioned into
+      shard rings (columnar when rows are scalar).
+    """
+
+    server_version = "metrics-tpu-fleet/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass
+
+    def _send_json(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _fail(self, status: int, message: str) -> None:
+        _obs.counter_inc("serve.http_errors", status=str(status))
+        self._send_json(status, {"error": message})
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        url = urlparse(self.path)
+        try:
+            if url.path == "/healthz":
+                self._healthz()
+            elif url.path == "/metrics":
+                self._metrics()
+            elif url.path == "/query":
+                self._query(parse_qs(url.query))
+            elif url.path == "/compute_all":
+                self._compute_all()
+            else:
+                self._fail(404, f"no route {url.path!r}")
+        except MetricsTPUUserError as err:
+            self._fail(400, str(err))
+        except BrokenPipeError:
+            pass
+        except Exception as err:  # one bad request must not kill the pool
+            self._fail(500, f"{type(err).__name__}: {err}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        try:
+            if url.path == "/ingest":
+                self._ingest()
+            else:
+                self._fail(404, f"no route {url.path!r}")
+        except MetricsTPUUserError as err:
+            self._fail(400, str(err))
+        except BrokenPipeError:
+            pass
+        except Exception as err:
+            self._fail(500, f"{type(err).__name__}: {err}")
+
+    # ------------------------------------------------------------ endpoints
+    def _healthz(self) -> None:
+        coord = self.server.coordinator
+        _obs.counter_inc("serve.healthz_requests")
+        payload = coord.health()
+        self._send_json(200 if payload["status"] == "serving" else 503, payload)
+
+    def _metrics(self) -> None:
+        _obs.counter_inc("serve.scrapes")
+        text = prometheus_text()
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @staticmethod
+    def _one(params: Dict[str, List[str]], name: str) -> Optional[str]:
+        vals = params.get(name)
+        return vals[-1] if vals else None
+
+    def _query(self, params: Dict[str, List[str]]) -> None:
+        coord = self.server.coordinator
+        name = self._one(params, "job")
+        if not name:
+            raise MetricsTPUUserError("query needs ?job=NAME")
+        if name not in coord.router.jobs():
+            self._fail(404, f"unknown job {name!r}")
+            return
+        _obs.counter_inc("serve.queries", job=name)
+        key: Any = self._one(params, "key")
+        if key is not None and key.lstrip("-").isdigit():
+            key = int(key)
+        out: Dict[str, Any] = {"job": name}
+        streams = self._one(params, "streams")
+        top_k = self._one(params, "top_k")
+        where = self._one(params, "where")
+        if streams is not None:
+            ids = [int(s) for s in streams.split(",") if s != ""]
+            out["streams"] = ids
+            out["values"] = coord.compute_streams(name, ids)
+        elif top_k is not None:
+            largest = self._one(params, "largest") != "0"
+            values, ids = coord.top_k(name, int(top_k), key=key, largest=largest)
+            out["top_k"] = values
+            out["stream_ids"] = ids
+            out["largest"] = largest
+        elif where is not None:
+            op, _, threshold = where.partition(":")
+            k = int(self._one(params, "k") or "16")
+            ids, total = coord.where(name, op, float(threshold), k=k, key=key)
+            out["stream_ids"] = ids
+            out["total_matches"] = total
+        else:
+            out["value"] = coord.compute(name)
+        self._send_json(200, out)
+
+    def _compute_all(self) -> None:
+        coord = self.server.coordinator
+        _obs.counter_inc("serve.queries", job="__all__")
+        self._send_json(200, {"values": coord.compute_all()})
+
+    def _ingest(self) -> None:
+        coord = self.server.coordinator
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0 or length > _MAX_INGEST_BYTES:
+            raise MetricsTPUUserError(
+                f"ingest needs a JSON body of 1..{_MAX_INGEST_BYTES} bytes"
+            )
+        try:
+            payload = json.loads(self.rfile.read(length).decode())
+        except (ValueError, UnicodeDecodeError) as err:
+            raise MetricsTPUUserError(f"ingest body is not valid JSON: {err}")
+        name = payload.get("job")
+        records = payload.get("records")
+        if not isinstance(name, str) or not isinstance(records, list):
+            raise MetricsTPUUserError(
+                'ingest body must be {"job": NAME, "records": [...]}'
+            )
+        if name not in coord.router.jobs():
+            self._fail(404, f"unknown job {name!r}")
+            return
+        # validate the WHOLE batch before staging any of it (same contract
+        # as the worker surface: a malformed record mid-list 400s cleanly)
+        parsed: List[Tuple[Tuple[Any, ...], Optional[int]]] = []
+        for i, rec in enumerate(records):
+            if not isinstance(rec, dict):
+                raise MetricsTPUUserError(
+                    f"record {i} must be a JSON object, got {type(rec).__name__}"
+                )
+            values = rec.get("values")
+            if not isinstance(values, list) or not values:
+                raise MetricsTPUUserError(f'record {i} needs "values": [...]')
+            stream_id = rec.get("stream_id")
+            if stream_id is not None and (
+                isinstance(stream_id, bool) or not isinstance(stream_id, int)
+            ):
+                raise MetricsTPUUserError(
+                    f'record {i} has a non-integer "stream_id": {stream_id!r}'
+                )
+            parsed.append((tuple(values), stream_id))
+        accepted, rejected = coord.ingest_records(name, parsed)
+        status = 429 if rejected and not accepted else 200
+        self._send_json(status, {"accepted": accepted, "rejected": rejected})

@@ -1,0 +1,303 @@
+"""Fleet assembly: shard registries, in-process workers, checkpointed respawn.
+
+This module is the **construction** side of the sharded serve tier — the
+coordinator (``coordinator.py``) stays a pure request path and never
+imports it.  Here live:
+
+* :func:`build_shard_registry` — the per-shard registry: every multistream
+  job re-registers at its span's width (same name, narrower stream axis);
+  a plain job registers only on the one shard the router's hash ring owns
+  it on.
+* :class:`InProcessShard` — the duck-typed handle the coordinator drives
+  when the whole fleet lives in one process (the fast tests and the
+  bench); it copies ring views at the enqueue boundary, because the
+  coordinator's ``commit`` frees the slots the views alias.
+* :class:`LocalFleet` — N in-process :class:`EvalServer` workers, one
+  coordinator, per-shard checkpoint directories, and the ``respawn``
+  callback that makes :meth:`FleetCoordinator.failover` work: a
+  replacement worker restores the dead shard's latest committed snapshot
+  on start (restore-on-start is EvalServer's normal boot path), then the
+  rows parked in the shard's staging ring drain into it.
+
+Determinism note for the failover drill: with interval flushing disabled
+(``flush_interval`` large), block dispatch boundaries depend only on each
+shard's cumulative row count — the carry-buffered
+:class:`~metrics_tpu.serve.ingest.BlockBatcher` dispatches whole blocks no
+matter how the rows were framed in flight — so a kill → respawn → drain
+run is bitwise identical to an uninterrupted one.
+"""
+# analyze: skip-file[serve-blocking] -- the fleet layer owns worker
+# construction and checkpoint restore-on-respawn: it wires the durability
+# machinery the request-path modules (router/columnar/coordinator) are
+# banned from touching.
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.checkpoint.manager import (
+    CheckpointManager,
+    shard_checkpoint_directory,
+)
+from metrics_tpu.multistream import MultiStreamMetric
+from metrics_tpu.obs import core as _obs
+from metrics_tpu.serve.coordinator import FleetCoordinator
+from metrics_tpu.serve.registry import MetricRegistry, _to_jsonable
+from metrics_tpu.serve.router import ShardRouter
+from metrics_tpu.serve.server import EvalServer, ServeConfig
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = [
+    "JobSpec",
+    "FleetSpec",
+    "build_router",
+    "build_shard_registry",
+    "InProcessShard",
+    "LocalFleet",
+]
+
+
+@dataclass
+class JobSpec:
+    """One job, fleet-wide.
+
+    ``build`` returns a FRESH metric instance each call — every shard (and
+    every respawn) constructs its own state.  ``num_streams`` is the
+    *global* stream axis; ``None`` marks a plain job placed whole by the
+    hash ring.
+    """
+
+    name: str
+    build: Callable[[], Any]
+    num_streams: Optional[int] = None
+    components: Optional[Sequence[str]] = None
+    export_top_k: int = 0
+
+
+@dataclass
+class FleetSpec:
+    """Everything needed to stand a fleet up (or respawn one shard of it)."""
+
+    num_shards: int
+    jobs: Sequence[JobSpec]
+    checkpoint_root: Optional[str] = None
+    server_config: ServeConfig = field(default_factory=ServeConfig)
+    vnodes: int = 64
+    ring_capacity: int = 8192
+    ingest_dtype: Any = np.float32
+    max_staleness: Optional[float] = None  # arms per-shard durability loops
+    query_timeout: float = 30.0
+
+
+def build_router(spec: FleetSpec) -> ShardRouter:
+    return ShardRouter(
+        spec.num_shards,
+        {job.name: job.num_streams for job in spec.jobs},
+        vnodes=spec.vnodes,
+    )
+
+
+def build_shard_registry(
+    spec: FleetSpec, shard: int, router: ShardRouter
+) -> MetricRegistry:
+    """The registry shard ``shard`` hosts.
+
+    Multistream jobs keep their fleet-wide name but wrap a fresh metric at
+    exactly the span's width — local row ``r`` IS global stream
+    ``lo + r``, which is what makes scatter-gather merges exact.  Plain
+    jobs register only on their ring-owned shard.
+    """
+    registry = MetricRegistry()
+    for job in spec.jobs:
+        if job.num_streams is not None:
+            width = router.span_width(job.name, shard)
+            registry.register(
+                job.name,
+                MultiStreamMetric(job.build(), num_streams=width),
+                components=job.components,
+                export_top_k=min(job.export_top_k, width),
+            )
+        elif router.owner(job.name) == int(shard):
+            registry.register(
+                job.name,
+                job.build(),
+                components=job.components,
+            )
+    if len(registry) == 0:
+        raise MetricsTPUUserError(
+            f"shard {shard} hosts no jobs (all plain jobs hashed elsewhere); "
+            "add a multistream job or shrink the fleet"
+        )
+    return registry
+
+
+class InProcessShard:
+    """Duck-typed shard handle over an in-process :class:`EvalServer`.
+
+    Mirrors :class:`~metrics_tpu.serve.coordinator.HTTPShard` exactly —
+    including the JSON-shaped return values (``_to_jsonable`` floats), so
+    a merge computed over in-process handles is bit-identical to one
+    computed over the HTTP wire.
+    """
+
+    def __init__(self, server: EvalServer) -> None:
+        self.server = server
+
+    # --------------------------------------------------------------- ingest
+    def ingest_columns(
+        self,
+        job: str,
+        cols: Sequence[np.ndarray],
+        stream_ids: Optional[np.ndarray] = None,
+    ) -> bool:
+        # the coordinator's ring views go stale at commit(): copy at the
+        # enqueue boundary (the HTTP handle serializes instead)
+        owned = tuple(np.array(c, copy=True) for c in cols)
+        ids = None if stream_ids is None else np.array(stream_ids, copy=True)
+        return self.server.submit_columns(job, owned, stream_ids=ids)
+
+    def ingest_rows(
+        self, job: str, rows: Sequence[Tuple[Tuple[Any, ...], Optional[int]]]
+    ) -> Tuple[int, int]:
+        accepted = rejected = 0
+        for values, stream_id in rows:
+            ok = self.server.submit(job, values, stream_id=stream_id)
+            accepted += int(ok)
+            rejected += int(not ok)
+        return accepted, rejected
+
+    # ---------------------------------------------------------------- reads
+    def compute(self, job: str) -> Any:
+        return _to_jsonable(self.server.registry[job].compute())
+
+    def compute_streams(self, job: str, local_ids: Sequence[int]) -> List[Any]:
+        return _to_jsonable(
+            self.server.registry[job].compute_streams(list(local_ids))
+        )
+
+    def top_k(
+        self, job: str, k: int, key: Any = None, largest: bool = True
+    ) -> Tuple[List[float], List[int]]:
+        values, ids = self.server.registry[job].top_k(k, key=key, largest=largest)
+        return (
+            _to_jsonable(values),
+            [int(i) for i in np.asarray(ids).reshape(-1)],
+        )
+
+    def where(
+        self, job: str, op: str, threshold: float, k: int, key: Any = None
+    ) -> Tuple[List[int], int]:
+        ids, total = self.server.registry[job].where_op(
+            op, float(threshold), k=k, key=key
+        )
+        kept = [int(i) for i in np.asarray(ids).reshape(-1) if int(i) >= 0]
+        return kept, int(np.asarray(total))
+
+    # ------------------------------------------------------------- liveness
+    def health(self) -> Dict[str, Any]:
+        return self.server.health()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        return self.server.flush(timeout=timeout)
+
+    def checkpoint(self) -> int:
+        return self.server.checkpoint_now()
+
+
+class LocalFleet:
+    """N in-process workers + one coordinator (tests and the bench).
+
+    Subprocess workers (``python -m metrics_tpu.serve.worker``) speak the
+    same protocol through :class:`~metrics_tpu.serve.coordinator.HTTPShard`;
+    the slow soak drill wires those up itself.
+    """
+
+    def __init__(self, spec: FleetSpec) -> None:
+        self.spec = spec
+        self.router = build_router(spec)
+        self._servers: List[Optional[EvalServer]] = [None] * spec.num_shards
+        self.coordinator: Optional[FleetCoordinator] = None
+        self._started = False
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "LocalFleet":
+        if self._started:
+            raise MetricsTPUUserError("LocalFleet.start() called twice")
+        self._started = True
+        handles = []
+        for shard in range(self.spec.num_shards):
+            server = self._spawn_server(shard)
+            self._servers[shard] = server
+            handles.append(InProcessShard(server))
+        self.coordinator = FleetCoordinator(
+            self.router,
+            handles,
+            respawn=self._respawn,
+            ring_capacity=self.spec.ring_capacity,
+            ingest_dtype=self.spec.ingest_dtype,
+            query_timeout=self.spec.query_timeout,
+        ).start()
+        return self
+
+    def _manager(self, shard: int) -> Optional[CheckpointManager]:
+        if self.spec.checkpoint_root is None:
+            return None
+        return CheckpointManager(
+            directory=shard_checkpoint_directory(
+                self.spec.checkpoint_root, shard
+            ),
+            max_staleness=self.spec.max_staleness,
+        )
+
+    def _spawn_server(self, shard: int) -> EvalServer:
+        registry = build_shard_registry(self.spec, shard, self.router)
+        config = replace(self.spec.server_config, port=0)
+        server = EvalServer(
+            registry,
+            config=config,
+            checkpoint_manager=self._manager(shard),
+        )
+        # restore-on-start: a respawn after kill_shard() picks the shard's
+        # latest committed snapshot right back up
+        server.start()
+        return server
+
+    def _respawn(self, shard: int) -> InProcessShard:
+        server = self._spawn_server(shard)
+        self._servers[shard] = server
+        return InProcessShard(server)
+
+    def server(self, shard: int) -> EvalServer:
+        srv = self._servers[int(shard)]
+        if srv is None:
+            raise MetricsTPUUserError(f"shard {shard} is not running")
+        return srv
+
+    # -------------------------------------------------------------- drills
+    def checkpoint_all(self) -> Dict[int, int]:
+        """Flush + snapshot every shard; ``{shard: committed_step}``."""
+        return {
+            shard: self.server(shard).checkpoint_now()
+            for shard in range(self.spec.num_shards)
+        }
+
+    def kill_shard(self, shard: int) -> None:
+        """Preemption: drop the shard's queue, no final checkpoint.  The
+        coordinator keeps parking its rows until :meth:`failover`."""
+        self.server(shard).kill()
+        _obs.counter_inc("serve.fleet_shard_kills", shard=str(shard))
+
+    def failover(self, shard: int) -> InProcessShard:
+        if self.coordinator is None:
+            raise MetricsTPUUserError("fleet is not started")
+        return self.coordinator.failover(shard)
+
+    def stop(self, final_checkpoint: bool = False) -> None:
+        if self.coordinator is not None:
+            self.coordinator.stop()
+        for shard, server in enumerate(self._servers):
+            if server is not None:
+                server.stop(final_checkpoint=final_checkpoint)

@@ -3,9 +3,11 @@
 No new dependencies and **no blocking collectives or KV waits on any
 request thread** — ``tools/serve_lint.py`` enforces that statically, and the
 registry's forced ``sync_on_compute=False`` enforces it dynamically.  The
-server is a ``ThreadingHTTPServer``: scrapes and queries stay responsive
-while the consumer thread dispatches blocks, because handlers only ever
-take a per-job lock around a local device read.
+server is a :class:`PooledHTTPServer` — a **bounded** worker pool instead
+of ``ThreadingHTTPServer``'s thread-per-connection, so a connection flood
+costs a 503 rather than unbounded thread spawn; handlers only ever take a
+per-job lock around a local device read, so scrapes and queries stay
+responsive while the consumer thread dispatches blocks.
 
 Endpoints:
 
@@ -19,12 +21,18 @@ Endpoints:
   [&key=...]`` (device-ranked), ``&where=gt:0.9&k=8`` (device-filtered).
 * ``POST /ingest`` — JSON records ``{"job": ..., "records": [{"values":
   [...], "stream_id": ...}, ...]}``; full queues reject with 429.
+* ``POST /ingest_columns`` — the fleet's columnar wire: one JSON header
+  line, then raw little-endian column bytes; parsed with ``np.frombuffer``
+  (no per-record objects) and enqueued as ONE
+  :class:`~metrics_tpu.serve.ingest.ColumnBatch`.
 """
 
 from __future__ import annotations
 
 import json
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -32,25 +40,140 @@ from metrics_tpu.obs import core as _obs
 from metrics_tpu.obs.exporters import metric_values_prometheus_text, prometheus_text
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
-__all__ = ["ServeHTTPServer", "make_http_server"]
+__all__ = ["PooledHTTPServer", "ServeHTTPServer", "make_http_server"]
 
 _MAX_INGEST_BYTES = 8 << 20
 
+_503_RAW = (
+    b"HTTP/1.1 503 Service Unavailable\r\n"
+    b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+)
 
-class ServeHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer carrying the owning EvalServer reference."""
+
+class PooledHTTPServer(HTTPServer):
+    """HTTP server dispatching connections to a bounded worker pool.
+
+    ``ThreadingHTTPServer`` spawns one thread per connection with no upper
+    bound — an ingest flood turns into thousands of threads before the
+    queue's backpressure ever engages.  Here the accept loop hands each
+    connection to a fixed pool through a bounded hand-off queue; when every
+    worker is busy and the queue is full the connection gets an immediate
+    raw 503 (load balancers retry elsewhere) instead of a growing backlog.
+
+    ``serve.frontend_threads_busy`` counts pool high-water marks: it ticks
+    each time the number of simultaneously busy workers reaches a new
+    maximum, so a scrape shows the worst concurrency the pool absorbed
+    (obs counters are monotone; there are no gauges to sample).
+    """
 
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, address: Tuple[str, int], eval_server: Any) -> None:
-        super().__init__(address, _Handler)
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        handler_cls: Any,
+        pool_threads: int = 8,
+        backlog: int = 64,
+    ) -> None:
+        super().__init__(address, handler_cls)
+        if int(pool_threads) < 1:
+            raise MetricsTPUUserError(
+                f"pool_threads must be >= 1, got {pool_threads}"
+            )
+        self._work_q: "queue.Queue[Tuple[Any, Any]]" = queue.Queue(
+            maxsize=max(1, int(backlog))
+        )
+        self._pool_stop = threading.Event()
+        self._busy_lock = threading.Lock()
+        try:  # named in the runtime lock-witness graph
+            self._busy_lock.witness_name = "PooledHTTPServer._busy_lock"
+        except AttributeError:
+            pass
+        self._busy = 0
+        self._busy_high_water = 0
+        self._pool = [
+            threading.Thread(
+                target=self._worker, name=f"http-pool-{i}", daemon=True
+            )
+            for i in range(int(pool_threads))
+        ]
+        for t in self._pool:
+            t.start()
+
+    # ------------------------------------------------------------ accept side
+    def process_request(self, request: Any, client_address: Any) -> None:
+        try:
+            self._work_q.put_nowait((request, client_address))
+        except queue.Full:
+            # saturated: fail fast with a raw 503 on the socket — the
+            # handler machinery needs a worker we do not have
+            _obs.counter_inc("serve.http_pool_rejections")
+            try:
+                request.sendall(_503_RAW)
+            except OSError:
+                pass
+            self.shutdown_request(request)
+
+    # ------------------------------------------------------------ worker side
+    def _note_busy(self, delta: int) -> None:
+        with self._busy_lock:
+            self._busy += delta
+            new_high = self._busy > self._busy_high_water
+            if new_high:
+                self._busy_high_water = self._busy
+        if new_high:
+            _obs.counter_inc("serve.frontend_threads_busy")
+
+    def _worker(self) -> None:
+        while not self._pool_stop.is_set():
+            try:
+                request, client_address = self._work_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._note_busy(1)
+            try:
+                self.finish_request(request, client_address)
+            except Exception:  # noqa: BLE001 — one bad socket must not kill a worker
+                self.handle_error(request, client_address)
+            finally:
+                self.shutdown_request(request)
+                self._note_busy(-1)
+
+    def server_close(self) -> None:
+        self._pool_stop.set()
+        for t in self._pool:
+            t.join(timeout=5.0)
+        super().server_close()
+
+
+class ServeHTTPServer(PooledHTTPServer):
+    """Pooled HTTP server carrying the owning EvalServer reference."""
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        eval_server: Any,
+        pool_threads: int = 8,
+        backlog: int = 64,
+    ) -> None:
+        super().__init__(
+            address, _Handler, pool_threads=pool_threads, backlog=backlog
+        )
         self.eval_server = eval_server
 
 
-def make_http_server(host: str, port: int, eval_server: Any) -> ServeHTTPServer:
+def make_http_server(
+    host: str,
+    port: int,
+    eval_server: Any,
+    pool_threads: int = 8,
+    backlog: int = 64,
+) -> ServeHTTPServer:
     """Bind the serve endpoints; ``port=0`` picks an ephemeral port."""
-    return ServeHTTPServer((host, port), eval_server)
+    return ServeHTTPServer(
+        (host, port), eval_server, pool_threads=pool_threads, backlog=backlog
+    )
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -100,6 +223,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/ingest":
                 self._ingest()
+            elif url.path == "/ingest_columns":
+                self._ingest_columns()
+            elif url.path == "/flush":
+                self._flush(parse_qs(url.query))
+            elif url.path == "/checkpoint":
+                self._checkpoint()
             else:
                 self._fail(404, f"no route {url.path!r}")
         except MetricsTPUUserError as err:
@@ -216,6 +345,98 @@ class _Handler(BaseHTTPRequestHandler):
             rejected += int(not ok)
         status = 429 if rejected and not accepted else 200
         self._send_json(status, {"accepted": accepted, "rejected": rejected})
+
+    def _ingest_columns(self) -> None:
+        """Columnar wire: ``<json header>\\n<raw column bytes>``.
+
+        The header is ``{"job": NAME, "rows": n, "arity": k,
+        "dtype": "<f4", "ids": bool}``; the payload is ``arity``
+        column blobs of ``n`` rows each, then (when ``ids``) one int32
+        blob of ``n`` stream ids.  Columns become ``np.frombuffer`` views
+        over the request body — no per-record Python objects anywhere on
+        this path — and enqueue as ONE ColumnBatch (one queue slot).
+        """
+        import numpy as np
+
+        srv = self.server.eval_server
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0 or length > _MAX_INGEST_BYTES:
+            raise MetricsTPUUserError(
+                f"ingest_columns needs a body of 1..{_MAX_INGEST_BYTES} bytes"
+            )
+        body = self.rfile.read(length)
+        nl = body.find(b"\n")
+        if nl < 0:
+            raise MetricsTPUUserError(
+                "ingest_columns body needs a JSON header line"
+            )
+        try:
+            header = json.loads(body[:nl].decode())
+        except (ValueError, UnicodeDecodeError) as err:
+            raise MetricsTPUUserError(f"bad ingest_columns header: {err}")
+        name = header.get("job")
+        rows = header.get("rows")
+        arity = header.get("arity")
+        if (
+            not isinstance(name, str)
+            or not isinstance(rows, int)
+            or not isinstance(arity, int)
+            or isinstance(rows, bool)
+            or isinstance(arity, bool)
+            or rows < 1
+            or arity < 1
+        ):
+            raise MetricsTPUUserError(
+                'ingest_columns header needs {"job", "rows" >= 1, "arity" >= 1}'
+            )
+        if name not in srv.registry:
+            self._fail(404, f"unknown job {name!r}")
+            return
+        try:
+            dtype = np.dtype(header.get("dtype", "<f4"))
+        except TypeError as err:
+            raise MetricsTPUUserError(f"bad ingest_columns dtype: {err}")
+        with_ids = bool(header.get("ids", False))
+        col_bytes = rows * dtype.itemsize
+        need = nl + 1 + arity * col_bytes + (rows * 4 if with_ids else 0)
+        if need != length:
+            raise MetricsTPUUserError(
+                f"ingest_columns header declares {need} bytes, body has {length}"
+            )
+        offset = nl + 1
+        cols = []
+        for _ in range(arity):
+            cols.append(
+                np.frombuffer(body, dtype=dtype, count=rows, offset=offset)
+            )
+            offset += col_bytes
+        stream_ids = (
+            np.frombuffer(body, dtype="<i4", count=rows, offset=offset)
+            if with_ids
+            else None
+        )
+        ok = srv.submit_columns(name, tuple(cols), stream_ids=stream_ids)
+        _obs.counter_inc("serve.column_batches", job=name)
+        status = 200 if ok else 429
+        self._send_json(
+            status,
+            {"accepted": rows if ok else 0, "rejected": 0 if ok else rows},
+        )
+
+    def _flush(self, params: Dict[str, List[str]]) -> None:
+        """Drain the ingest queue + dispatch all staged rows (fleet drills
+        call this on each shard before a coordinated read or checkpoint)."""
+        srv = self.server.eval_server
+        timeout = float(self._one(params, "timeout") or "10.0")
+        ok = srv.flush(timeout=timeout)
+        self._send_json(200 if ok else 504, {"flushed": bool(ok)})
+
+    def _checkpoint(self) -> None:
+        """Operator-triggered durable snapshot (the coordinator's failover
+        drill checkpoints a shard before killing it)."""
+        srv = self.server.eval_server
+        step = srv.checkpoint_now()
+        self._send_json(200, {"step": int(step)})
 
 
 def _as_int_list(arr: Any) -> List[int]:

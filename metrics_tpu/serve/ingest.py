@@ -19,6 +19,14 @@ state math, applied to the serving path:
   ``log2(block_rows)+1`` distinct shapes ever reach the compiler, and every
   row is dispatched exactly once — bit-identical to calling ``update``
   directly with the same rows.
+* **Residual-row carry**: a non-forced flush dispatches only whole blocks
+  and carries the sub-block tail to the next flush, so steady-state
+  traffic never pays the pow2 tail dispatches; the consumer forces a tail
+  out only once it has waited a full ``flush_interval``.
+
+Rows arrive either as one :class:`Record` per queue item or as a
+:class:`ColumnBatch` — pre-stacked column arrays the sharded frontend
+forwards as views, one queue slot per batch.
 
 Back-pressure is the queue bound: a full queue rejects the record (counted
 in ``serve.records_rejected``) instead of stalling the producer or growing
@@ -29,7 +37,8 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -37,7 +46,7 @@ from metrics_tpu.obs import core as _obs
 from metrics_tpu.serve.registry import EvalJob, MetricRegistry
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
-__all__ = ["Record", "IngestQueue", "BlockBatcher", "IngestConsumer"]
+__all__ = ["Record", "ColumnBatch", "IngestQueue", "BlockBatcher", "IngestConsumer"]
 
 
 class Record(NamedTuple):
@@ -52,6 +61,21 @@ class Record(NamedTuple):
     job: str
     values: Tuple[Any, ...]
     stream_id: Optional[int] = None
+
+
+class ColumnBatch(NamedTuple):
+    """Many rows for one job, already columnar.
+
+    The sharded frontend stages ingest into pre-allocated column arrays and
+    forwards contiguous views — one queue item per batch instead of one
+    Python object per record.  ``cols`` holds one ``(n, ...)`` array per
+    update argument; ``stream_ids`` is an ``(n,)`` int32 array on
+    multistream jobs and ``None`` on plain jobs.
+    """
+
+    job: str
+    cols: Tuple[np.ndarray, ...]
+    stream_ids: Optional[np.ndarray] = None
 
 
 class _FlushToken:
@@ -71,9 +95,13 @@ class IngestQueue:
         self._q: "queue.Queue[Any]" = queue.Queue(maxsize=int(capacity))
         self.capacity = int(capacity)
 
-    def put(self, record: Record, timeout: Optional[float] = None) -> bool:
-        """Enqueue one record; ``False`` (and a counter tick) when the queue
-        is full past ``timeout`` — bounded memory beats unbounded lag."""
+    def put(
+        self, record: Union[Record, ColumnBatch], timeout: Optional[float] = None
+    ) -> bool:
+        """Enqueue one record (or one columnar batch — a batch costs one
+        queue slot no matter how many rows it carries); ``False`` (and a
+        counter tick) when the queue is full past ``timeout`` — bounded
+        memory beats unbounded lag."""
         try:
             if timeout is None:
                 self._q.put_nowait(record)
@@ -125,7 +153,19 @@ def _pow2_chunks(n: int, cap: int) -> List[int]:
 
 
 class BlockBatcher:
-    """Per-job row accumulator that emits static-shape ``update`` dispatches."""
+    """Per-job row accumulator that emits static-shape ``update`` dispatches.
+
+    Buffered rows live as columnar *segments* (one per staged row-batch or
+    :class:`ColumnBatch`).  A **forced** flush dispatches everything —
+    full blocks, then the pow2 tail (plain) or one padded block
+    (multistream) — bit-identical to dispatching the rows directly.  A
+    **non-forced** flush dispatches only whole ``block_rows`` blocks and
+    *carries* the residue, so steady-state traffic costs exactly one
+    full-block dispatch per ``block_rows`` rows instead of up to
+    ``log2(block_rows)+1`` tail dispatches per flush.  ``age`` lets the
+    consumer force a flush only when the carried rows have actually gone
+    stale, preserving the ingest-to-state latency bound.
+    """
 
     def __init__(self, job: EvalJob, block_rows: int = 256) -> None:
         if int(block_rows) < 1:
@@ -140,10 +180,20 @@ class BlockBatcher:
         self.block_rows = b
         self._rows: List[Tuple[Any, ...]] = []
         self._ids: List[int] = []
+        # carried columnar segments: (cols, ids-or-None, n) in arrival order
+        self._segments: List[Tuple[List[np.ndarray], Optional[np.ndarray], int]] = []
+        self._segments_n = 0
+        self._oldest: Optional[float] = None  # monotonic enqueue time, oldest row
         self.rows_padded = 0  # host counter: pad rows ever dispatched
 
     def __len__(self) -> int:
-        return len(self._rows)
+        return self._segments_n + len(self._rows)
+
+    def age(self, now: Optional[float] = None) -> float:
+        """Seconds the oldest buffered row has waited (0.0 when empty)."""
+        if self._oldest is None:
+            return 0.0
+        return (time.monotonic() if now is None else now) - self._oldest
 
     def add(self, record: Record) -> None:
         if self.job.is_multistream:
@@ -157,8 +207,55 @@ class BlockBatcher:
                 f"job {self.job.name!r} is {self.job.kind}; stream_id must be None"
             )
         self._rows.append(record.values)
-        if len(self._rows) >= self.block_rows:
-            self.flush()
+        if self._oldest is None:
+            self._oldest = time.monotonic()
+        if len(self) >= self.block_rows:
+            # a full block dispatches as-is; force would add nothing
+            self.flush(force=False)
+
+    def extend_columns(
+        self, cols: Sequence[np.ndarray], stream_ids: Optional[np.ndarray] = None
+    ) -> int:
+        """Buffer ``n`` already-columnar rows without per-record objects.
+
+        ``cols`` are views or arrays with a shared leading dim ``n``; they
+        are staged as one segment (no copy) and dispatched on the next
+        block boundary.  Returns ``n``.
+        """
+        if self.job.is_multistream:
+            if stream_ids is None:
+                raise MetricsTPUUserError(
+                    f"job {self.job.name!r} is multistream; batches need stream_ids"
+                )
+        elif stream_ids is not None:
+            raise MetricsTPUUserError(
+                f"job {self.job.name!r} is {self.job.kind}; stream_ids must be None"
+            )
+        cols = [np.asarray(c) for c in cols]
+        if not cols:
+            raise MetricsTPUUserError("ColumnBatch needs at least one column")
+        n = int(cols[0].shape[0]) if cols[0].ndim else -1
+        if n < 0 or any(c.ndim == 0 or c.shape[0] != n for c in cols):
+            raise MetricsTPUUserError(
+                f"job {self.job.name!r}: columns must share one leading dim"
+            )
+        ids = None
+        if stream_ids is not None:
+            ids = np.asarray(stream_ids, np.int32).reshape(-1)
+            if ids.shape[0] != n:
+                raise MetricsTPUUserError(
+                    f"job {self.job.name!r}: stream_ids length {ids.shape[0]} != {n}"
+                )
+        if n == 0:
+            return 0
+        self._stage_rows()  # keep arrival order when add() rows are pending
+        self._segments.append((cols, ids, n))
+        self._segments_n += n
+        if self._oldest is None:
+            self._oldest = time.monotonic()
+        if len(self) >= self.block_rows:
+            self.flush(force=False)
+        return n
 
     # ------------------------------------------------------------- dispatch
     def _stack(self, rows: Sequence[Tuple[Any, ...]]) -> List[np.ndarray]:
@@ -169,47 +266,117 @@ class BlockBatcher:
             )
         return [np.stack([np.asarray(r[i]) for r in rows]) for i in range(arity)]
 
-    def flush(self) -> int:
-        """Dispatch everything buffered; returns the number of rows sent."""
-        if not self._rows:
-            return 0
+    def _stage_rows(self) -> None:
+        """Move the row-major add() buffer into one columnar segment.  The
+        rows are consumed before stacking so a malformed batch is dropped
+        (and counted by the caller), never retried forever."""
         rows, self._rows = self._rows, []
         ids, self._ids = self._ids, []
+        if not rows:
+            return
         cols = self._stack(rows)
-        n = len(rows)
+        seg_ids = np.asarray(ids, np.int32) if self.job.is_multistream else None
+        self._segments.append((cols, seg_ids, len(rows)))
+        self._segments_n += len(rows)
+
+    def _take(self, count: int) -> Tuple[List[np.ndarray], Optional[np.ndarray]]:
+        """Pop the first ``count`` buffered rows as one columnar batch.
+        Whole segments pass through as views; a straddling segment is split
+        by slicing (still views) — at most one concatenate per flush."""
+        parts: List[Tuple[List[np.ndarray], Optional[np.ndarray]]] = []
+        got = 0
+        while got < count:
+            cols, ids, n = self._segments[0]
+            take = min(n, count - got)
+            if take == n:
+                self._segments.pop(0)
+                parts.append((cols, ids))
+            else:
+                parts.append(
+                    ([c[:take] for c in cols], None if ids is None else ids[:take])
+                )
+                self._segments[0] = (
+                    [c[take:] for c in cols],
+                    None if ids is None else ids[take:],
+                    n - take,
+                )
+            got += take
+        self._segments_n -= count
+        if not self._segments:
+            self._oldest = None
+        if len(parts) == 1:
+            return parts[0]
+        arity = len(parts[0][0])
+        if any(len(p[0]) != arity for p in parts):
+            raise MetricsTPUUserError(
+                f"job {self.job.name!r} received records of mixed arity"
+            )
+        cols = [np.concatenate([p[0][i] for p in parts]) for i in range(arity)]
+        ids = (
+            None
+            if parts[0][1] is None
+            else np.concatenate([p[1] for p in parts])
+        )
+        return cols, ids
+
+    def flush(self, force: bool = True) -> int:
+        """Dispatch buffered rows; returns the number of rows sent.
+
+        ``force=True`` (the default — what flush tokens, drains and
+        checkpoints use) sends everything, tail included.  ``force=False``
+        sends only whole blocks and carries the residue for the next flush.
+        """
+        if self._rows:
+            self._stage_rows()
+        n = self._segments_n
+        if not n:
+            return 0
+        send = n if force else (n // self.block_rows) * self.block_rows
+        if not send:
+            return 0
+        cols, ids = self._take(send)
         with self.job.lock:
             if self.job.is_multistream:
-                pad = self.block_rows - n
-                padded = [
-                    np.concatenate(
-                        [c, np.zeros((pad,) + c.shape[1:], c.dtype)]
-                    ) if pad else c
-                    for c in cols
-                ]
-                # -1 is out of [0, num_streams): the on-device scatter drops
-                # the pad rows, so short blocks stay bit-exact; num_valid
-                # (a size-1 array, so it traces instead of retracing per
-                # fill) keeps them out of the dropped_rows accounting too
-                id_col = np.full((self.block_rows,), -1, np.int32)
-                id_col[:n] = np.asarray(ids, np.int32)
-                self.job.metric.update(
-                    *padded, stream_ids=id_col, num_valid=np.asarray([n], np.int32)
-                )
-                self.rows_padded += pad
-                if pad:
-                    _obs.counter_inc("serve.rows_padded", pad)
-                self.job.blocks_dispatched += 1
-                _obs.counter_inc("serve.blocks_dispatched", job=self.job.name)
+                start = 0
+                while start < send:
+                    m = min(self.block_rows, send - start)
+                    block = [c[start : start + m] for c in cols]
+                    pad = self.block_rows - m
+                    if pad:
+                        block = [
+                            np.concatenate(
+                                [c, np.zeros((pad,) + c.shape[1:], c.dtype)]
+                            )
+                            for c in block
+                        ]
+                    # -1 is out of [0, num_streams): the on-device scatter
+                    # drops the pad rows, so short blocks stay bit-exact;
+                    # num_valid (a size-1 array, so it traces instead of
+                    # retracing per fill) keeps them out of the
+                    # dropped_rows accounting too
+                    id_col = np.full((self.block_rows,), -1, np.int32)
+                    id_col[:m] = ids[start : start + m]
+                    self.job.metric.update(
+                        *block,
+                        stream_ids=id_col,
+                        num_valid=np.asarray([m], np.int32),
+                    )
+                    self.rows_padded += pad
+                    if pad:
+                        _obs.counter_inc("serve.rows_padded", pad)
+                    self.job.blocks_dispatched += 1
+                    _obs.counter_inc("serve.blocks_dispatched", job=self.job.name)
+                    start += m
             else:
                 start = 0
-                for size in _pow2_chunks(n, self.block_rows):
+                for size in _pow2_chunks(send, self.block_rows):
                     self.job.metric.update(*[c[start : start + size] for c in cols])
                     start += size
                     self.job.blocks_dispatched += 1
                     _obs.counter_inc("serve.blocks_dispatched", job=self.job.name)
-            self.job.records_ingested += n
-        _obs.counter_inc("serve.records_ingested", n)
-        return n
+            self.job.records_ingested += send
+        _obs.counter_inc("serve.records_ingested", send)
+        return send
 
 
 class IngestConsumer:
@@ -258,13 +425,22 @@ class IngestConsumer:
         if len(self.errors) < self._MAX_ERRORS:
             self.errors.append(message)
 
-    def flush_all(self) -> int:
+    def flush_all(self, stale_after: Optional[float] = None) -> int:
         """Flush every batcher.  A batch that fails to dispatch is dropped
-        and counted — it must not wedge the writer or starve other jobs."""
+        and counted — it must not wedge the writer or starve other jobs.
+
+        ``stale_after=None`` (tokens, drains, checkpoints) forces every
+        tail out.  With a threshold (the interval flush), a batcher only
+        forces its sub-block tail once its oldest row has waited that
+        long; younger residues carry forward so steady-state traffic
+        dispatches full blocks only.
+        """
         total = 0
+        now = time.monotonic() if stale_after is not None else 0.0
         for batcher in self.batchers.values():
+            force = stale_after is None or batcher.age(now) >= stale_after
             try:
-                total += batcher.flush()
+                total += batcher.flush(force=force)
             except Exception as err:  # noqa: BLE001 — untrusted rows reach np.stack/update
                 _obs.counter_inc("serve.flush_failures", job=batcher.job.name)
                 self.record_error(
@@ -294,7 +470,10 @@ class IngestConsumer:
                 _obs.counter_inc("serve.records_unroutable")
                 self.record_error(f"unknown job {item.job!r}")
                 return last_flush
-            batcher.add(item)
+            if isinstance(item, ColumnBatch):
+                batcher.extend_columns(item.cols, item.stream_ids)
+            else:
+                batcher.add(item)
         except MetricsTPUUserError as err:
             _obs.counter_inc("serve.records_malformed")
             self.record_error(str(err))
@@ -315,9 +494,11 @@ class IngestConsumer:
             elif self.stop.is_set():
                 break  # queue drained after stop: graceful exit
             # the latency bound applies under steady trickle too, not just
-            # when the queue goes idle
+            # when the queue goes idle; a tail younger than the interval is
+            # carried (full-block dispatches only), so the worst-case
+            # ingest-to-state latency is ~2x flush_interval
             if now - last_flush >= self.flush_interval:
-                if self.flush_all():
+                if self.flush_all(stale_after=self.flush_interval):
                     _obs.counter_inc("serve.interval_flushes")
                 last_flush = now
         if not self.kill.is_set():

@@ -35,7 +35,13 @@ from typing import Any, Dict, Optional, Tuple
 from metrics_tpu.checkpoint.manager import CheckpointManager
 from metrics_tpu.obs import core as _obs
 from metrics_tpu.serve.httpd import make_http_server
-from metrics_tpu.serve.ingest import IngestConsumer, IngestQueue, Record, _FlushToken
+from metrics_tpu.serve.ingest import (
+    ColumnBatch,
+    IngestConsumer,
+    IngestQueue,
+    Record,
+    _FlushToken,
+)
 from metrics_tpu.serve.registry import MetricRegistry
 from metrics_tpu.utils.exceptions import CheckpointError, MetricsTPUUserError
 
@@ -154,6 +160,27 @@ class EvalServer:
             _obs.counter_inc("serve.records_rejected", reason="draining")
             return False
         return self.queue.put(Record(job, tuple(values), stream_id), timeout=timeout)
+
+    def submit_columns(
+        self,
+        job: str,
+        cols: Tuple[Any, ...],
+        stream_ids: Optional[Any] = None,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Enqueue many rows as ONE columnar batch (one queue slot).
+
+        ``cols`` are pre-stacked ``(n, ...)`` arrays — the zero-copy path
+        the sharded frontend forwards ring views through; the consumer
+        carries them straight into block dispatches without ever
+        materializing per-record Python objects.
+        """
+        if self._draining:
+            _obs.counter_inc("serve.records_rejected", reason="draining")
+            return False
+        return self.queue.put(
+            ColumnBatch(job, tuple(cols), stream_ids), timeout=timeout
+        )
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Force every partial block into metric state and wait for it.

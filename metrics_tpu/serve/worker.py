@@ -1,0 +1,133 @@
+"""Shard worker entrypoint: one :class:`EvalServer` hosting one shard.
+
+The slow fleet drills run workers as real processes::
+
+    python -m metrics_tpu.serve.worker --shard 1 --num-shards 4 \
+        --num-streams 64 --checkpoint-root /tmp/fleet-ckpt
+
+The worker builds the SAME per-shard registry :class:`LocalFleet` would
+build for that shard (``mse`` plain job + ``per_tenant`` multistream job —
+the drill vocabulary from ``serve.soak``), binds an ephemeral port unless
+``--port`` is given, prints ``READY <port>`` on stdout for the parent to
+scrape, and serves until SIGTERM/SIGINT.  The coordinator talks to it
+through :class:`~metrics_tpu.serve.coordinator.HTTPShard`; a kill -9 →
+respawn of this process is the fleet failover drill.
+"""
+# analyze: skip-file[serve-blocking] -- process entrypoint: owns worker
+# construction and the final drain/checkpoint on shutdown, like the fleet
+# layer it mirrors.
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+from typing import List, Optional, Sequence
+
+from metrics_tpu.serve.fleet import (
+    FleetSpec,
+    JobSpec,
+    build_router,
+    build_shard_registry,
+)
+from metrics_tpu.serve.server import EvalServer, ServeConfig
+
+__all__ = ["drill_jobs", "run_worker", "main"]
+
+
+def drill_jobs(num_streams: int) -> List[JobSpec]:
+    """The fleet drill vocabulary: one plain job, one multistream job."""
+    from metrics_tpu.regression import MeanSquaredError
+
+    return [
+        JobSpec("mse", MeanSquaredError, num_streams=None),
+        JobSpec("per_tenant", MeanSquaredError, num_streams=int(num_streams)),
+    ]
+
+
+def run_worker(
+    shard: int,
+    num_shards: int,
+    num_streams: int = 64,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    checkpoint_root: Optional[str] = None,
+    block_rows: int = 64,
+    flush_interval: float = 3600.0,
+    max_staleness: Optional[float] = None,
+) -> EvalServer:
+    """Build shard ``shard``'s registry and start its server (started)."""
+    spec = FleetSpec(
+        num_shards=int(num_shards),
+        jobs=drill_jobs(num_streams),
+        checkpoint_root=checkpoint_root,
+        server_config=ServeConfig(
+            host=host,
+            port=int(port),
+            block_rows=int(block_rows),
+            # large interval = no wall-clock forcing: dispatch boundaries
+            # stay a pure function of row count (the bitwise-drill contract)
+            flush_interval=float(flush_interval),
+        ),
+        max_staleness=max_staleness,
+    )
+    router = build_router(spec)
+    registry = build_shard_registry(spec, int(shard), router)
+    manager = None
+    if checkpoint_root is not None:
+        from metrics_tpu.checkpoint.manager import (
+            CheckpointManager,
+            shard_checkpoint_directory,
+        )
+
+        manager = CheckpointManager(
+            directory=shard_checkpoint_directory(checkpoint_root, int(shard)),
+            max_staleness=max_staleness,
+        )
+    server = EvalServer(
+        registry, config=spec.server_config, checkpoint_manager=manager
+    )
+    return server.start()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m metrics_tpu.serve.worker",
+        description="one shard worker of the sharded serve fleet",
+    )
+    parser.add_argument("--shard", type=int, required=True)
+    parser.add_argument("--num-shards", type=int, required=True)
+    parser.add_argument("--num-streams", type=int, default=64)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--checkpoint-root", default=None)
+    parser.add_argument("--block-rows", type=int, default=64)
+    parser.add_argument("--flush-interval", type=float, default=3600.0)
+    parser.add_argument("--max-staleness", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    server = run_worker(
+        shard=args.shard,
+        num_shards=args.num_shards,
+        num_streams=args.num_streams,
+        host=args.host,
+        port=args.port,
+        checkpoint_root=args.checkpoint_root,
+        block_rows=args.block_rows,
+        flush_interval=args.flush_interval,
+        max_staleness=args.max_staleness,
+    )
+    print(f"READY {server.port}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda _s, _f: stop.set())
+    while not stop.wait(timeout=0.2):
+        pass
+    # graceful drain; the final checkpoint only exists with a manager
+    server.stop(final_checkpoint=args.checkpoint_root is not None)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

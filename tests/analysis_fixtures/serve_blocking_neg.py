@@ -1,0 +1,20 @@
+# serve-blocking negatives: a scatter-gather request path that reads
+# local state only and bounds every wait — 0 findings expected
+import threading
+
+
+class ScatterGather:
+    def __init__(self, metric, handles, pool, timeout=30.0):
+        self.metric = metric
+        self.handles = handles
+        self.pool = pool
+        self.timeout = timeout
+        self._stop = threading.Event()
+
+    def query_top_k(self, k):
+        futures = [self.pool.submit(h.top_k, k) for h in self.handles]
+        # bounded waits on our own worker pool, never on a peer
+        return [f.result(timeout=self.timeout) for f in futures]
+
+    def idle(self, seconds):
+        self._stop.wait(seconds)  # timed wait: fine

@@ -1,0 +1,26 @@
+# serve-blocking positives: 4 findings expected
+# (1 banned-import + 3 blocking-call on the scatter-gather request path)
+import metrics_tpu.parallel  # banned-import: distributed machinery
+
+
+class ScatterGather:
+    """A coordinator whose query fan-out blocks on peers — the exact
+    failure mode the pass exists to keep out of request paths."""
+
+    def __init__(self, metric, handles):
+        self.metric = metric
+        self.handles = handles
+
+    def query_top_k(self, k):
+        # blocking-call: an explicit metric sync inside a request handler
+        self.metric.sync()
+        return [h.top_k(k) for h in self.handles]
+
+    def _gather(self, futures):
+        # blocking-call: a distributed barrier on the read path
+        wait_at_barrier("fleet-gather")
+        return [f.result() for f in futures]
+
+    def _peer_state(self, key):
+        # blocking-call: a parked KV wait — a dead peer hangs the request
+        return blocking_key_value_get(key)

@@ -1,0 +1,382 @@
+"""Mini-fleet end-to-end: 2 in-process workers, one coordinator.
+
+The merge-equality tests feed dyadic rationals (multiples of 1/8) so
+float32 accumulation is exact no matter how block boundaries fall — a
+sharded fleet and one worker over the whole stream axis must then agree
+bitwise, not just approximately.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from metrics_tpu.obs import (
+    counter_value,
+    parse_prometheus_text,
+    prometheus_text,
+    summarize_counters,
+)
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.serve import (
+    FleetSpec,
+    JobSpec,
+    LocalFleet,
+    ServeConfig,
+    make_fleet_http_server,
+)
+from metrics_tpu.serve.soak import trees_bitwise_equal
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+S = 8
+BLOCK = 8
+
+
+def _spec(num_shards, checkpoint_root=None):
+    return FleetSpec(
+        num_shards=num_shards,
+        jobs=[
+            JobSpec("mse", MeanSquaredError),
+            JobSpec("tenants", MeanSquaredError, num_streams=S, export_top_k=2),
+        ],
+        checkpoint_root=checkpoint_root,
+        server_config=ServeConfig(block_rows=BLOCK, flush_interval=3600.0),
+        ring_capacity=1024,
+    )
+
+
+def _dyadic_batch(n, lo=0, streams=6):
+    """Deterministic dyadic traffic touching streams [0, streams)."""
+    i = np.arange(lo, lo + n)
+    preds = ((i * 3) % 32).astype(np.float32) / 8.0
+    targets = ((i * 5) % 16).astype(np.float32) / 8.0
+    sids = (i % streams).astype(np.int64)
+    return preds, targets, sids
+
+
+def _feed(coordinator, n, lo=0, streams=6):
+    preds, targets, sids = _dyadic_batch(n, lo=lo, streams=streams)
+    accepted, rejected = coordinator.ingest_columns(
+        "tenants", [preds, targets], sids
+    )
+    assert rejected == 0 and accepted == n
+    accepted, rejected = coordinator.ingest_columns("mse", [preds, targets])
+    assert rejected == 0 and accepted == n
+
+
+@pytest.fixture
+def fleets():
+    alive = []
+
+    def make(num_shards, checkpoint_root=None):
+        fleet = LocalFleet(_spec(num_shards, checkpoint_root)).start()
+        alive.append(fleet)
+        return fleet
+
+    yield make
+    for fleet in alive:
+        fleet.stop()
+
+
+def _get_json(port, path, expect=200):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10.0
+        ) as r:
+            assert r.status == expect
+            return json.loads(r.read())
+    except urllib.error.HTTPError as err:
+        assert err.code == expect, f"{path}: HTTP {err.code}: {err.read()!r}"
+        return json.loads(err.read())
+
+
+def _post_json(port, path, payload, expect=200):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            assert r.status == expect
+            return json.loads(r.read())
+    except urllib.error.HTTPError as err:
+        assert err.code == expect, f"{path}: HTTP {err.code}: {err.read()!r}"
+        return json.loads(err.read())
+
+
+@pytest.fixture
+def frontend():
+    servers = []
+
+    def make(fleet):
+        srv = make_fleet_http_server("127.0.0.1", 0, fleet.coordinator)
+        thread = threading.Thread(
+            target=lambda: srv.serve_forever(poll_interval=0.05), daemon=True
+        )
+        thread.start()
+        servers.append((srv, thread))
+        return srv.server_address[1]
+
+    yield make
+    for srv, thread in servers:
+        srv.shutdown()
+        thread.join(timeout=5.0)
+        srv.server_close()
+
+
+class TestShardPartition:
+    def test_registries_partition_the_stream_axis(self, fleets):
+        fleet = fleets(2)
+        widths = []
+        plain_hosts = []
+        for shard in range(2):
+            registry = fleet.server(shard).registry
+            widths.append(registry["tenants"].metric.num_streams)
+            plain_hosts.append("mse" in registry)
+        assert sum(widths) == S
+        assert widths == [
+            fleet.router.span_width("tenants", s) for s in range(2)
+        ]
+        # the plain job lives on exactly the ring-owned shard
+        assert plain_hosts.count(True) == 1
+        assert plain_hosts.index(True) == fleet.router.owner("mse")
+
+    def test_rows_land_on_their_span(self, fleets):
+        fleet = fleets(2)
+        lo, hi = fleet.router.span("tenants", 1)
+        sids = np.arange(lo, hi, dtype=np.int64)
+        cols = [np.ones(len(sids), np.float32), np.zeros(len(sids), np.float32)]
+        fleet.coordinator.ingest_columns("tenants", cols, sids)
+        assert fleet.coordinator.flush(10.0)
+        values = fleet.server(1).registry["tenants"].compute_streams(
+            list(range(hi - lo))
+        )
+        assert all(float(v) == 1.0 for v in np.asarray(values))
+        # shard 0's spans saw nothing
+        other = np.asarray(
+            fleet.server(0).registry["tenants"].compute_streams(
+                list(range(fleet.router.span_width("tenants", 0)))
+            )
+        )
+        assert np.isnan(other).all()
+
+
+class TestScatterGather:
+    def test_merges_match_single_worker_bitwise(self, fleets):
+        fleet, solo = fleets(2), fleets(1)
+        for coord in (fleet.coordinator, solo.coordinator):
+            _feed(coord, 150)
+            # engineer a tie: streams 1 and 4 get one identical extra row
+            coord.ingest_columns(
+                "tenants",
+                [np.float32([1.0, 1.0]), np.float32([0.5, 0.5])],
+                np.int64([1, 4]),
+            )
+            assert coord.flush(10.0)
+
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute_all(), solo.coordinator.compute_all()
+        )
+        ids = [5, 0, 3, 1]
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute_streams("tenants", ids),
+            solo.coordinator.compute_streams("tenants", ids),
+        )
+        for k in (1, 3, 6):
+            for largest in (True, False):
+                assert fleet.coordinator.top_k(
+                    "tenants", k, largest=largest
+                ) == solo.coordinator.top_k("tenants", k, largest=largest)
+        for op, threshold in (("ge", 0.25), ("lt", 1.0), ("gt", 100.0)):
+            assert fleet.coordinator.where(
+                "tenants", op, threshold, k=S
+            ) == solo.coordinator.where("tenants", op, threshold, k=S)
+
+    def test_untouched_streams_rank_last_and_never_match(self, fleets):
+        fleet = fleets(2)
+        _feed(fleet.coordinator, 60, streams=6)  # streams 6, 7 untouched
+        assert fleet.coordinator.flush(10.0)
+        values, ids = fleet.coordinator.top_k("tenants", S)
+        assert set(ids) == set(range(S))
+        assert ids[-2:] == [6, 7]  # NaN sinks, id breaks the tie
+        assert all(np.isnan(v) for v in values[-2:])
+        matched, total = fleet.coordinator.where("tenants", "ge", -1e9, k=S)
+        assert 6 not in matched and 7 not in matched
+        assert total == 6
+
+    def test_stream_id_validation(self, fleets):
+        fleet = fleets(2)
+        with pytest.raises(MetricsTPUUserError):
+            fleet.coordinator.compute_streams("tenants", [S])
+        with pytest.raises(MetricsTPUUserError):
+            fleet.coordinator.top_k("tenants", S + 1)
+
+    def test_ingest_records_scalar_path_matches_columns(self, fleets):
+        fleet, twin = fleets(2), fleets(2)
+        preds, targets, sids = _dyadic_batch(40)
+        records = [
+            ((float(p), float(t)), int(s))
+            for p, t, s in zip(preds, targets, sids)
+        ]
+        accepted, rejected = fleet.coordinator.ingest_records("tenants", records)
+        assert (accepted, rejected) == (40, 0)
+        twin.coordinator.ingest_columns("tenants", [preds, targets], sids)
+        assert fleet.coordinator.flush(10.0) and twin.coordinator.flush(10.0)
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute("tenants"),
+            twin.coordinator.compute("tenants"),
+        )
+
+    def test_ingest_records_rejects_missing_stream_ids(self, fleets):
+        fleet = fleets(2)
+        accepted, rejected = fleet.coordinator.ingest_records(
+            "tenants", [((1.0, 0.5), 2), ((1.0, 0.5), None)]
+        )
+        assert (accepted, rejected) == (1, 1)
+
+
+class TestFailover:
+    def test_kill_failover_restores_bitwise(self, fleets, tmp_path):
+        fleet = fleets(2, checkpoint_root=str(tmp_path / "fleet"))
+        twin = fleets(2, checkpoint_root=str(tmp_path / "twin"))
+
+        # identical cadence on both fleets: feed, flush, checkpoint —
+        # only the kill/failover differs, so compute_all must match bitwise
+        for f in (fleet, twin):
+            _feed(f.coordinator, 70)
+            assert f.coordinator.flush(10.0)
+            steps = f.checkpoint_all()
+            assert set(steps) == {0, 1}
+
+        victim = fleet.router.shard_for("tenants", 0)
+        fleet.kill_shard(victim)
+        assert fleet.coordinator.health()["status"] == "degraded"
+
+        failovers_before = counter_value("serve.failovers", shard=str(victim))
+        for f in (fleet, twin):
+            _feed(f.coordinator, 50, lo=70)  # victim's rows park in its ring
+        fleet.failover(victim)
+        assert (
+            counter_value("serve.failovers", shard=str(victim))
+            == failovers_before + 1
+        )
+        for f in (fleet, twin):
+            assert f.coordinator.flush(10.0)
+
+        assert fleet.coordinator.health()["status"] == "serving"
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute_all(), twin.coordinator.compute_all()
+        )
+
+    def test_health_rollup_names_dead_shards(self, fleets):
+        fleet = fleets(2)
+        assert fleet.coordinator.health()["dead_shards"] == []
+        fleet.kill_shard(0)
+        info = fleet.coordinator.health()
+        assert info["status"] == "degraded"
+        assert info["dead_shards"] == [0]
+
+
+class TestHTTPFrontend:
+    def test_roundtrip_and_healthz_degradation(self, fleets, frontend):
+        fleet = fleets(2)
+        port = frontend(fleet)
+
+        # touch every stream and both jobs: JSON round-trips NaN as the
+        # canonical quiet NaN, which need not match the device's bit pattern
+        batch = _dyadic_batch(30, streams=8)
+        records = [
+            {"values": [float(p), float(t)], "stream_id": int(s)}
+            for p, t, s in zip(*batch)
+        ]
+        out = _post_json(port, "/ingest", {"job": "tenants", "records": records})
+        assert out == {"accepted": 30, "rejected": 0}
+        plain = [{"values": r["values"]} for r in records]
+        out = _post_json(port, "/ingest", {"job": "mse", "records": plain})
+        assert out == {"accepted": 30, "rejected": 0}
+        assert fleet.coordinator.flush(10.0)
+
+        expected_values, expected_ids = fleet.coordinator.top_k("tenants", 3)
+        out = _get_json(port, "/query?job=tenants&top_k=3")
+        assert out["stream_ids"] == expected_ids
+        assert out["top_k"] == expected_values
+
+        out = _get_json(port, "/query?job=tenants&streams=2,0")
+        assert trees_bitwise_equal(
+            out["values"], fleet.coordinator.compute_streams("tenants", [2, 0])
+        )
+        out = _get_json(port, "/query?job=tenants&where=ge:0.25&k=8")
+        ids, total = fleet.coordinator.where("tenants", "ge", 0.25, k=8)
+        assert (out["stream_ids"], out["total_matches"]) == (ids, total)
+
+        out = _get_json(port, "/compute_all")
+        assert trees_bitwise_equal(out["values"], fleet.coordinator.compute_all())
+
+        _get_json(port, "/query?job=nope", expect=404)
+        _post_json(
+            port,
+            "/ingest",
+            {"job": "tenants", "records": [{"values": []}]},
+            expect=400,
+        )
+
+        assert _get_json(port, "/healthz")["status"] == "serving"
+        fleet.kill_shard(1)
+        assert _get_json(port, "/healthz", expect=503)["status"] == "degraded"
+        fleet.failover(1)
+        assert _get_json(port, "/healthz")["status"] == "serving"
+
+
+class TestServeCounters:
+    def test_counters_surface_and_roundtrip(self, fleets, frontend):
+        before = {
+            name: counter_value(name)
+            for name in ("serve.scatter_queries",)
+        }
+        routes_before = sum(
+            counter_value("serve.shard_routes", shard=str(s)) for s in range(2)
+        )
+        busy_before = counter_value("serve.frontend_threads_busy")
+
+        fleet = fleets(2)
+        port = frontend(fleet)
+        _feed(fleet.coordinator, 40)
+        assert fleet.coordinator.flush(10.0)
+        _get_json(port, "/query?job=tenants&top_k=2")
+        fleet.coordinator.compute_all()
+
+        routes_after = sum(
+            counter_value("serve.shard_routes", shard=str(s)) for s in range(2)
+        )
+        assert routes_after > routes_before
+        # a fresh frontend pool records its first high-water mark
+        assert counter_value("serve.frontend_threads_busy") > busy_before
+        scatter = sum(
+            counter_value("serve.scatter_queries", op=op)
+            for op in ("top_k", "compute", "compute_streams", "where")
+        )
+        assert scatter > before["serve.scatter_queries"]
+
+        summary = summarize_counters()
+        assert summary["serve"]["shard_routes"] == int(routes_after)
+        assert summary["serve"]["scatter_queries"] >= 1
+        assert "failovers" in summarize_counters(
+            {("serve.failovers", (("shard", "0"),)): 2.0}
+        ).get("serve", {})
+
+        parsed = parse_prometheus_text(prometheus_text())
+        for shard in range(2):
+            key = (
+                "metrics_tpu_serve_shard_routes_total",
+                (("shard", str(shard)),),
+            )
+            assert parsed[key] == counter_value(
+                "serve.shard_routes", shard=str(shard)
+            )
+        busy_key = ("metrics_tpu_serve_frontend_threads_busy_total", ())
+        assert parsed[busy_key] == counter_value("serve.frontend_threads_busy")

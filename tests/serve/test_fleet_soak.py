@@ -1,0 +1,190 @@
+"""Slow tier: the fleet chaos drill with REAL worker processes.
+
+Two subprocess workers behind one coordinator, process-mode load over
+HTTP, then a SIGKILL on one worker mid-ingest and a checkpointed respawn.
+The recovered fleet's ``compute_all`` must be bit-identical to an
+uninterrupted twin fleet fed the same records.  Run with ``-m slow``.
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.obs import counter_value
+from metrics_tpu.serve import (
+    ColumnTraffic,
+    FleetCoordinator,
+    FleetSpec,
+    HTTPShard,
+    make_fleet_http_server,
+    run_process_load,
+)
+from metrics_tpu.serve.fleet import build_router
+from metrics_tpu.serve.soak import trees_bitwise_equal
+from metrics_tpu.serve.worker import drill_jobs
+
+NUM_SHARDS = 2
+S = 16
+BLOCK = 8
+
+
+class WorkerProc:
+    """One ``python -m metrics_tpu.serve.worker`` child + its HTTP handle."""
+
+    def __init__(self, shard, checkpoint_root):
+        self.shard = shard
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "metrics_tpu.serve.worker",
+                "--shard", str(shard),
+                "--num-shards", str(NUM_SHARDS),
+                "--num-streams", str(S),
+                "--block-rows", str(BLOCK),
+                "--checkpoint-root", checkpoint_root,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        line = self.proc.stdout.readline().strip()
+        assert line.startswith("READY "), f"worker {shard}: {line!r}"
+        self.port = int(line.split()[1])
+        self.handle = HTTPShard("127.0.0.1", self.port)
+
+    def sigkill(self):
+        self.proc.kill()
+        self.proc.wait(timeout=10.0)
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10.0)
+
+
+class SubprocessFleet:
+    """A coordinator over subprocess workers, with respawn-from-checkpoint."""
+
+    def __init__(self, checkpoint_root):
+        self.checkpoint_root = checkpoint_root
+        spec = FleetSpec(num_shards=NUM_SHARDS, jobs=drill_jobs(S))
+        self.router = build_router(spec)
+        self.workers = [
+            WorkerProc(shard, checkpoint_root) for shard in range(NUM_SHARDS)
+        ]
+        self.coordinator = FleetCoordinator(
+            self.router,
+            [w.handle for w in self.workers],
+            respawn=self._respawn,
+            ring_capacity=4096,
+        ).start()
+
+    def _respawn(self, shard):
+        replacement = WorkerProc(shard, self.checkpoint_root)
+        self.workers[shard] = replacement
+        return replacement.handle
+
+    def feed(self, lo, hi):
+        """Deterministic single-threaded feed: both runs see the same rows
+        in the same order, so block boundaries (and float accumulation
+        order) match exactly."""
+        tenant = ColumnTraffic("per_tenant", arity=2, num_streams=S, seed=21)
+        plain = ColumnTraffic("mse", arity=2, seed=22)
+        for start in range(lo, hi, 64):
+            end = min(start + 64, hi)
+            cols, ids = tenant.batch(start, end)
+            accepted, rejected = self.coordinator.ingest_columns(
+                "per_tenant", cols, ids
+            )
+            assert (accepted, rejected) == (end - start, 0)
+            cols, _ = plain.batch(start, end)
+            accepted, rejected = self.coordinator.ingest_columns("mse", cols)
+            assert (accepted, rejected) == (end - start, 0)
+
+    def checkpoint_all(self):
+        # the workers' HTTP POST /flush + /checkpoint routes, end to end
+        return {w.shard: w.handle.checkpoint() for w in self.workers}
+
+    def stop(self):
+        self.coordinator.stop()
+        for w in self.workers:
+            w.terminate()
+
+
+@pytest.mark.slow
+def test_subprocess_fleet_kill9_failover_is_bitwise(tmp_path):
+    fleet = SubprocessFleet(str(tmp_path / "fleet"))
+    twin = SubprocessFleet(str(tmp_path / "twin"))
+    frontend = make_fleet_http_server("127.0.0.1", 0, fleet.coordinator)
+    http_thread = threading.Thread(
+        target=lambda: frontend.serve_forever(poll_interval=0.1), daemon=True
+    )
+    http_thread.start()
+    try:
+        # phase 1: identical cadence on both fleets, snapshots committed
+        for f in (fleet, twin):
+            f.feed(0, 600)
+            assert f.coordinator.flush(60.0)
+            steps = f.checkpoint_all()
+            assert sorted(steps) == [0, 1]
+
+        # SIGKILL one worker: no drain, no final checkpoint
+        victim = fleet.router.shard_for("per_tenant", 0)
+        fleet.workers[victim].sigkill()
+        deadline = time.monotonic() + 30.0
+        while fleet.coordinator.health()["status"] != "degraded":
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        assert fleet.coordinator.health()["dead_shards"] == [victim]
+
+        # phase 2 rows keep flowing: the dead shard's park in its ring
+        failovers = counter_value("serve.failovers", shard=str(victim))
+        for f in (fleet, twin):
+            f.feed(600, 900)
+
+        fleet.coordinator.failover(victim)
+        assert (
+            counter_value("serve.failovers", shard=str(victim))
+            == failovers + 1
+        )
+        for f in (fleet, twin):
+            assert f.coordinator.flush(60.0)
+        assert fleet.coordinator.health()["status"] == "serving"
+
+        # the durability claim, over real process boundaries: recovery is
+        # bit-identical to never having died
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute_all(), twin.coordinator.compute_all()
+        )
+
+        # process-mode load against the recovered frontend stays clean
+        port = frontend.server_address[1]
+        report = run_process_load(
+            f"http://127.0.0.1:{port}",
+            "per_tenant",
+            total_records=400,
+            processes=2,
+            batch_rows=50,
+            num_streams=S,
+        )
+        assert report.records == 400
+        assert report.accepted == 400 and report.rejected == 0
+        assert report.errors == []
+        assert fleet.coordinator.flush(60.0)
+        values, ids = fleet.coordinator.top_k("per_tenant", 4)
+        assert len(values) == len(ids) == 4
+    finally:
+        frontend.shutdown()
+        http_thread.join(timeout=5.0)
+        frontend.server_close()
+        fleet.stop()
+        twin.stop()

@@ -102,6 +102,91 @@ class TestBlockBatcher:
         assert len(batcher) == 0
         assert reg["mse"].records_ingested == 4
 
+    def test_nonforced_flush_carries_the_residue(self):
+        """Steady state pays exactly one full-block dispatch per block_rows
+        rows — the tail is CARRIED, not chopped into pow2 chunks."""
+        reg = _plain_registry()
+        batcher = BlockBatcher(reg["mse"], block_rows=8)
+        for i in range(21):
+            batcher.add(Record("mse", (np.float32(i), np.float32(0))))
+        # add() auto-flushes non-forced at each block boundary: 2 whole
+        # blocks went out, 5 rows stayed buffered
+        assert reg["mse"].blocks_dispatched == 2
+        assert reg["mse"].records_ingested == 16
+        assert len(batcher) == 5
+        # an explicit non-forced flush with a sub-block residue is a no-op
+        assert batcher.flush(force=False) == 0
+        assert reg["mse"].blocks_dispatched == 2
+        assert len(batcher) == 5
+        # the carry completes the NEXT block instead of dispatching alone
+        for i in range(3):
+            batcher.add(Record("mse", (np.float32(i), np.float32(1))))
+        assert reg["mse"].blocks_dispatched == 3
+        assert len(batcher) == 0
+        # force only pays the pow2 tail when there is one: 5 rows -> 4+1
+        for i in range(5):
+            batcher.add(Record("mse", (np.float32(i), np.float32(2))))
+        assert batcher.flush(force=True) == 5
+        assert reg["mse"].blocks_dispatched == 5
+        assert reg["mse"].records_ingested == 29
+
+    def test_carry_keeps_the_oldest_row_age(self):
+        reg = _plain_registry()
+        batcher = BlockBatcher(reg["mse"], block_rows=8)
+        assert batcher.age(now=123.0) == 0.0
+        batcher.add(Record("mse", (1.0, 0.0)))
+        assert batcher.age() > 0.0
+        batcher.flush(force=False)  # residue carried: still aging
+        assert len(batcher) == 1 and batcher.age() > 0.0
+        batcher.flush(force=True)
+        assert batcher.age(now=123.0) == 0.0
+
+    def test_multistream_carry_defers_padding(self):
+        """Non-forced flushes never pad: pad rows only exist when a force
+        dispatches a short tail block."""
+        S = 8
+        reg = _multi_registry(S)
+        batcher = BlockBatcher(reg["tenants"], block_rows=8)
+        rng = np.random.default_rng(7)
+        preds = rng.uniform(size=21).astype(np.float32)
+        target = rng.uniform(size=21).astype(np.float32)
+        ids = rng.integers(0, S, size=21).astype(np.int32)
+        batcher.extend_columns([preds, target], ids)
+        assert reg["tenants"].blocks_dispatched == 2
+        assert batcher.rows_padded == 0
+        assert len(batcher) == 5
+        assert batcher.flush(force=True) == 5
+        assert reg["tenants"].blocks_dispatched == 3
+        assert batcher.rows_padded == 3  # one short block, padded to 8
+
+        direct = MultiStreamMetric(MeanSquaredError(), num_streams=S)
+        direct.update(preds, target, stream_ids=ids)
+        np.testing.assert_array_equal(
+            np.asarray(reg["tenants"].compute()), np.asarray(direct.compute())
+        )
+
+    def test_extend_columns_matches_per_record_adds(self):
+        reg_cols, reg_rows = _multi_registry(), _multi_registry()
+        cols_batcher = BlockBatcher(reg_cols["tenants"], block_rows=8)
+        rows_batcher = BlockBatcher(reg_rows["tenants"], block_rows=8)
+        rng = np.random.default_rng(8)
+        preds = rng.uniform(size=13).astype(np.float32)
+        target = rng.uniform(size=13).astype(np.float32)
+        ids = rng.integers(0, 8, size=13).astype(np.int32)
+        cols_batcher.extend_columns([preds, target], ids)
+        for p, t, s in zip(preds, target, ids):
+            rows_batcher.add(Record("tenants", (p, t), int(s)))
+        cols_batcher.flush()
+        rows_batcher.flush()
+        np.testing.assert_array_equal(
+            np.asarray(reg_cols["tenants"].compute()),
+            np.asarray(reg_rows["tenants"].compute()),
+        )
+        assert (
+            reg_cols["tenants"].blocks_dispatched
+            == reg_rows["tenants"].blocks_dispatched
+        )
+
     def test_validation(self):
         reg = _plain_registry()
         mreg = _multi_registry()

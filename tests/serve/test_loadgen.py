@@ -1,0 +1,146 @@
+"""Load generator: counter-keyed traffic, thread mode, process mode."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.multistream import MultiStreamMetric
+from metrics_tpu.obs import counter_value
+from metrics_tpu.regression import MeanSquaredError
+from metrics_tpu.serve import (
+    ColumnTraffic,
+    EvalServer,
+    MetricRegistry,
+    ServeConfig,
+    run_load,
+    run_process_load,
+)
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+S = 8
+
+
+class TestColumnTraffic:
+    def test_batches_are_pure_in_seed_and_offset(self):
+        a = ColumnTraffic("mse", arity=2, num_streams=S, seed=3)
+        b = ColumnTraffic("mse", arity=2, num_streams=S, seed=3)
+        cols_a, ids_a = a.batch(100, 164)
+        cols_b, ids_b = b.batch(100, 164)
+        for x, y in zip(cols_a, cols_b):
+            assert np.array_equal(x, y)
+        assert np.array_equal(ids_a, ids_b)
+        # a different window is genuinely different traffic
+        cols_c, _ = a.batch(164, 228)
+        assert not np.array_equal(cols_a[0], cols_c[0])
+        # ...and a different seed too
+        cols_d, _ = ColumnTraffic("mse", arity=2, num_streams=S, seed=4).batch(
+            100, 164
+        )
+        assert not np.array_equal(cols_a[0], cols_d[0])
+
+    def test_batch_is_stateless(self):
+        # the generator is counter-keyed by the batch's lo: interleaving
+        # other draws between calls cannot perturb a window's contents
+        t = ColumnTraffic("mse", arity=2, num_streams=S, seed=9)
+        first_cols, first_ids = t.batch(64, 128)
+        t.batch(0, 8192)  # unrelated draw in between
+        again_cols, again_ids = t.batch(64, 128)
+        for x, y in zip(first_cols, again_cols):
+            assert np.array_equal(x, y)
+        assert np.array_equal(first_ids, again_ids)
+
+    def test_plain_job_has_no_ids(self):
+        cols, ids = ColumnTraffic("mse", arity=2).batch(0, 10)
+        assert ids is None and len(cols) == 2
+
+    def test_multistream_ids_stay_in_range(self):
+        _cols, ids = ColumnTraffic("t", arity=2, num_streams=S).batch(0, 500)
+        assert ids.min() >= 0 and ids.max() < S
+
+
+class TestRunLoad:
+    def _server(self):
+        registry = MetricRegistry()
+        registry.register(
+            "tenants", MultiStreamMetric(MeanSquaredError(), num_streams=S)
+        )
+        return EvalServer(
+            registry,
+            ServeConfig(block_rows=8, flush_interval=3600.0),
+        ).start()
+
+    def test_report_counts_and_flush_in_window(self):
+        srv = self._server()
+        traffic = ColumnTraffic("tenants", arity=2, num_streams=S, seed=1)
+        flushed = []
+
+        def ingest(lo, hi):
+            cols, ids = traffic.batch(lo, hi)
+            ok = srv.submit_columns("tenants", cols, stream_ids=ids)
+            return (hi - lo, 0) if ok else (0, hi - lo)
+
+        runs_before = counter_value("serve.loadgen_runs")
+        report = run_load(
+            ingest,
+            total_records=200,
+            batch_rows=64,
+            threads=2,
+            query=lambda: srv.registry["tenants"].top_k(2),
+            flush=lambda: flushed.append(srv.flush(10.0)) or flushed[-1],
+        )
+        assert report.records == 200
+        assert report.accepted == 200 and report.rejected == 0
+        assert report.errors == []
+        assert report.elapsed_s > 0 and report.records_per_s > 0
+        assert report.query_count > 0 and report.query_errors == 0
+        assert report.query_p99_ms >= report.query_p50_ms > 0
+        assert flushed == [True]  # flush ran, inside the timed window
+        assert counter_value("serve.loadgen_runs") == runs_before + 1
+        # the flush means throughput measured applied state: all 200 rows
+        # are readable now
+        values = np.asarray(srv.registry["tenants"].compute_streams(list(range(S))))
+        assert not np.isnan(values).any()
+        srv.stop(final_checkpoint=False)
+
+    def test_ingest_exceptions_become_report_errors(self):
+        def ingest(lo, hi):
+            if lo >= 64:
+                raise RuntimeError("backend down")
+            return hi - lo, 0
+
+        report = run_load(ingest, total_records=128, batch_rows=64)
+        assert report.accepted == 64
+        assert len(report.errors) == 1 and "backend down" in report.errors[0]
+
+    def test_rejects_empty_runs(self):
+        with pytest.raises(MetricsTPUUserError):
+            run_load(lambda lo, hi: (0, 0), total_records=0)
+
+
+class TestRunProcessLoad:
+    def test_children_post_over_http(self):
+        registry = MetricRegistry()
+        registry.register(
+            "tenants", MultiStreamMetric(MeanSquaredError(), num_streams=S)
+        )
+        srv = EvalServer(
+            registry, ServeConfig(block_rows=8, flush_interval=3600.0)
+        ).start()
+        try:
+            report = run_process_load(
+                f"http://127.0.0.1:{srv.port}",
+                "tenants",
+                total_records=96,
+                processes=2,
+                batch_rows=32,
+                num_streams=S,
+            )
+            assert report.records == 96
+            assert report.accepted == 96 and report.rejected == 0
+            assert report.errors == []
+            assert srv.flush(10.0)
+            values = np.asarray(
+                srv.registry["tenants"].compute_streams(list(range(S)))
+            )
+            assert not np.isnan(values).any()
+        finally:
+            srv.stop(final_checkpoint=False)

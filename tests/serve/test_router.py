@@ -1,0 +1,141 @@
+"""ShardRouter / HashRing: span math, clamping, vectorized partition."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.obs import counter_value
+from metrics_tpu.serve import HashRing, ShardRouter
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+class TestHashRing:
+    def test_lookup_is_deterministic_across_instances(self):
+        a = HashRing(range(4), vnodes=32)
+        b = HashRing(range(4), vnodes=32)
+        for key in ("mse", "accuracy", "f1", "a/b/c", ""):
+            assert a.lookup(key) == b.lookup(key)
+
+    def test_lookup_spreads_keys(self):
+        ring = HashRing(range(4), vnodes=64)
+        owners = {ring.lookup(f"job-{i}") for i in range(200)}
+        assert owners == {0, 1, 2, 3}
+
+    def test_resize_moves_a_minority_of_keys(self):
+        small = HashRing(range(4), vnodes=64)
+        grown = HashRing(range(5), vnodes=64)
+        keys = [f"job-{i}" for i in range(500)]
+        moved = sum(small.lookup(k) != grown.lookup(k) for k in keys)
+        # consistent hashing: ~1/5 of keys move to the new shard; a full
+        # reshuffle would move ~4/5
+        assert moved < len(keys) // 2
+
+    def test_validation(self):
+        with pytest.raises(MetricsTPUUserError):
+            HashRing([])
+        with pytest.raises(MetricsTPUUserError):
+            HashRing([0], vnodes=0)
+
+
+class TestSpans:
+    def test_spans_cover_contiguously(self):
+        router = ShardRouter(3, {"tenants": 10})
+        spans = [router.span("tenants", s) for s in range(3)]
+        assert spans[0][0] == 0
+        assert spans[-1][1] == 10
+        for (_, hi), (lo, _) in zip(spans, spans[1:]):
+            assert hi == lo
+        assert sum(router.span_width("tenants", s) for s in range(3)) == 10
+        assert router.num_streams("tenants") == 10
+
+    def test_every_stream_routes_to_its_span(self):
+        router = ShardRouter(3, {"tenants": 10})
+        for sid in range(10):
+            shard = router.shard_for("tenants", sid)
+            lo, hi = router.span("tenants", shard)
+            assert lo <= sid < hi
+            s2, local = router.local_id("tenants", sid)
+            assert s2 == shard and local == sid - lo
+            assert router.global_id("tenants", shard, local) == sid
+
+    def test_out_of_range_ids_clamp_but_keep_local_offset(self):
+        router = ShardRouter(2, {"tenants": 8})
+        shard, local = router.local_id("tenants", -3)
+        assert shard == 0 and local == -3
+        shard, local = router.local_id("tenants", 11)
+        lo, _hi = router.span("tenants", 1)
+        assert shard == 1 and local == 11 - lo
+        # the local offset lands outside the span width, so the worker's
+        # device drop lane counts it exactly like an unsharded worker would
+        assert local >= router.span_width("tenants", 1)
+
+    def test_plain_job_placement(self):
+        router = ShardRouter(4, {"mse": None, "tenants": 16})
+        owner = router.owner("mse")
+        assert 0 <= owner < 4
+        assert router.shard_for("mse") == owner
+        assert not router.is_multistream("mse")
+        assert router.is_multistream("tenants")
+        # same ring, same placement in a rebuilt router
+        assert ShardRouter(4, {"mse": None}).owner("mse") == owner
+
+    def test_error_surfaces(self):
+        router = ShardRouter(2, {"mse": None, "tenants": 8})
+        with pytest.raises(MetricsTPUUserError):
+            router.shard_for("nope")
+        with pytest.raises(MetricsTPUUserError):
+            router.shard_for("tenants")  # multistream needs a stream_id
+        with pytest.raises(MetricsTPUUserError):
+            router.owner("tenants")
+        with pytest.raises(MetricsTPUUserError):
+            router.span("mse", 0)
+        with pytest.raises(MetricsTPUUserError):
+            router.num_streams("mse")
+        with pytest.raises(MetricsTPUUserError):
+            router.partition_ids("mse", np.arange(3))
+        with pytest.raises(MetricsTPUUserError):
+            ShardRouter(0, {})
+        with pytest.raises(MetricsTPUUserError):
+            ShardRouter(4, {"tenants": 2})  # fewer streams than shards
+
+
+class TestPartitionIds:
+    def test_partition_matches_scalar_routing(self):
+        router = ShardRouter(3, {"tenants": 11})
+        rng = np.random.default_rng(0)
+        ids = rng.integers(-2, 13, size=64).astype(np.int64)  # includes OOB
+        parts = router.partition_ids("tenants", ids)
+        seen = np.zeros(len(ids), bool)
+        for shard, (positions, locals_) in parts.items():
+            assert not seen[positions].any()
+            seen[positions] = True
+            lo = router.span("tenants", shard)[0]
+            for pos, local in zip(positions, locals_):
+                exp_shard, exp_local = router.local_id("tenants", int(ids[pos]))
+                assert exp_shard == shard
+                assert int(local) == exp_local == int(ids[pos]) - lo
+
+        assert seen.all()  # every row lands on exactly one shard
+
+    def test_partition_preserves_arrival_order_within_shard(self):
+        router = ShardRouter(2, {"tenants": 8})
+        ids = np.array([7, 0, 5, 1, 6, 2], np.int64)
+        parts = router.partition_ids("tenants", ids)
+        for positions, _locals in parts.values():
+            assert list(positions) == sorted(positions)
+
+    def test_partition_counts_routes(self):
+        router = ShardRouter(2, {"tenants": 8})
+        before = sum(
+            counter_value("serve.shard_routes", shard=str(s)) for s in range(2)
+        )
+        router.partition_ids("tenants", np.arange(8))
+        after = sum(
+            counter_value("serve.shard_routes", shard=str(s)) for s in range(2)
+        )
+        assert after == before + 8
+
+    def test_empty_shards_are_omitted(self):
+        router = ShardRouter(4, {"tenants": 16})
+        lo, hi = router.span("tenants", 2)
+        parts = router.partition_ids("tenants", np.arange(lo, hi))
+        assert list(parts) == [2]

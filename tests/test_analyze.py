@@ -124,6 +124,9 @@ def test_shape_static_scope_covers_detection():
 # ---------------------------------------------------------------------------
 
 FIXTURE_CASES = [
+    ("serve_blocking_pos.py", "serve-blocking", 4,
+     {"banned-import", "blocking-call"}),
+    ("serve_blocking_neg.py", "serve-blocking", 0, set()),
     ("trace_safety_pos.py", "trace-safety", 4,
      {"host-pull", "host-cast", "numpy-in-trace", "traced-branch"}),
     ("trace_safety_neg.py", "trace-safety", 0, set()),
@@ -139,9 +142,18 @@ FIXTURE_CASES = [
 ]
 
 
+# serve-blocking only applies under its scope prefix; other fixtures run
+# under the default pretend path
+FIXTURE_RELS = {
+    "serve_blocking_pos.py": "metrics_tpu/serve/synthetic.py",
+    "serve_blocking_neg.py": "metrics_tpu/serve/synthetic.py",
+}
+
+
 @pytest.mark.parametrize("fname,pass_name,count,rules", FIXTURE_CASES)
 def test_fixture_finding_counts(fname, pass_name, count, rules):
-    findings = analyze_source(pass_name, _fixture(fname))
+    rel = FIXTURE_RELS.get(fname, "metrics_tpu/synthetic.py")
+    findings = analyze_source(pass_name, _fixture(fname), rel=rel)
     rendered = "\n".join(f.render() for f in findings)
     assert len(findings) == count, rendered
     assert {f.rule for f in findings} == rules, rendered
