@@ -1420,6 +1420,169 @@ def _bench_serve_fleet(
     return rate_w[top_width], profile
 
 
+def _bench_fleet_resize(
+    n_records=40_000,
+    block_rows=256,
+    num_streams=256,
+    batch_rows=2048,
+):
+    """Config 12: elastic resize under live ingest — the migration price.
+
+    A 2-shard fleet takes columnar traffic while it grows to 4 shards and
+    then shrinks to 3, with a feeder thread pushing batches THROUGH both
+    migrations: held-job rows park in the staging rings and drain against
+    the new epoch, so the numbers price the whole protocol (hold, quiesce,
+    span export/import, epoch flip, drain) and not an idle fleet.  Reported
+    per migration: wall-clock, rows moved between shards, and the parked
+    backlog at the moment the holds lift.  The steady-state window runs
+    after the final topology's block shapes are warmed and must close with
+    ``timed_recompiles == 0`` — resizing must not leave the fleet paying
+    trace costs afterwards.
+    """
+    import threading
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.obs import counters_snapshot
+    from metrics_tpu.serve import (
+        ColumnTraffic,
+        FleetSpec,
+        JobSpec,
+        LocalFleet,
+        ServeConfig,
+    )
+
+    def _timed_jits(before):
+        return sum(
+            int(v - before.get(k, 0))
+            for k, v in counters_snapshot().items()
+            if k[0] == "jit_traces"
+        )
+
+    spec = FleetSpec(
+        num_shards=2,
+        jobs=[
+            JobSpec("mse", MeanSquaredError, num_streams=None),
+            JobSpec("per_tenant", MeanSquaredError, num_streams=num_streams),
+        ],
+        server_config=ServeConfig(
+            block_rows=block_rows, queue_capacity=65536, flush_interval=3600.0
+        ),
+        # rings sized to the run: the bench prices the migration protocol,
+        # not ring backpressure
+        ring_capacity=max(n_records, 65536),
+    )
+    fleet = LocalFleet(spec).start()
+    try:
+        tenant = ColumnTraffic(
+            "per_tenant", arity=2, num_streams=num_streams, seed=13
+        )
+        plain = ColumnTraffic("mse", arity=2, seed=14)
+        cursor = [0]
+
+        def ingest(rows):
+            lo = cursor[0]
+            cursor[0] += rows
+            cols, sids = tenant.batch(lo, lo + rows)
+            a1, r1 = fleet.coordinator.ingest_columns("per_tenant", cols, sids)
+            cols2, _ = plain.batch(lo, lo + rows)
+            a2, r2 = fleet.coordinator.ingest_columns("mse", cols2)
+            if r1 or r2:
+                raise RuntimeError(f"resize bench rejected {r1 + r2} row(s)")
+            return a1 + a2
+
+        def warm(width):
+            ingest(2 * block_rows * width - 1)
+            if not fleet.coordinator.flush(120.0):
+                raise RuntimeError("resize bench warmup flush timed out")
+
+        def migrate(width):
+            # feeder pushes batches through the migration window: held-job
+            # rows park in the rings and drain post-flip
+            stop = threading.Event()
+            errors = []
+
+            def pump():
+                while not stop.is_set():
+                    try:
+                        ingest(batch_rows)
+                    except Exception as err:  # noqa: BLE001 — surfaced below
+                        errors.append(str(err))
+                        return
+                    stop.wait(0.01)
+
+            parked = {"rows": 0}
+
+            def hook(phase):
+                if phase == "released":
+                    # the backlog at the instant the holds lift is what
+                    # the drain phase has to move to the new owners; stop
+                    # the feeder here so drain prices that backlog, not an
+                    # open-ended race with fresh traffic
+                    parked["rows"] = fleet.coordinator.ring_stats()[
+                        "staged_rows"
+                    ]
+                    stop.set()
+
+            feeder = threading.Thread(target=pump, daemon=True)
+            feeder.start()
+            try:
+                summary = fleet.resize(width, timeout=300.0, phase_hook=hook)
+            finally:
+                stop.set()
+                feeder.join(timeout=30.0)
+            if errors:
+                raise RuntimeError(f"resize bench feeder failed: {errors[0]}")
+            if not fleet.coordinator.flush(120.0):
+                raise RuntimeError("post-resize flush timed out")
+            return {
+                "wall_ms": round(summary["wall_secs"] * 1e3, 3),
+                "rows_moved": summary["rows_moved"],
+                "rows_parked": int(parked["rows"]),
+                "drained": bool(summary["drained"]),
+                "epoch": summary["epoch"],
+            }
+
+        warm(2)
+        grow = migrate(4)
+        warm(4)
+        shrink = migrate(3)
+        warm(3)
+
+        jit0 = counters_snapshot()
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            applied = 0
+            while applied < n_records:
+                applied += ingest(min(2 * batch_rows, n_records - applied))
+            if not fleet.coordinator.flush(120.0):
+                raise RuntimeError("steady-state flush timed out")
+            rates.append(applied / (time.perf_counter() - t0))
+        recompiles = _timed_jits(jit0)
+
+        profile = {
+            "records": n_records,
+            "block_rows": block_rows,
+            "num_streams": num_streams,
+            "grow": grow,
+            "shrink": shrink,
+            "grow_wall_ms": grow["wall_ms"],
+            "grow_rows_moved": grow["rows_moved"],
+            "grow_rows_parked": grow["rows_parked"],
+            "grow_drained": grow["drained"],
+            "shrink_wall_ms": shrink["wall_ms"],
+            "shrink_rows_moved": shrink["rows_moved"],
+            "shrink_rows_parked": shrink["rows_parked"],
+            "shrink_drained": shrink["drained"],
+            "final_epoch": shrink["epoch"],
+            "steady_state_rps": round(float(np.median(rates)), 1),
+            "timed_recompiles": recompiles,
+        }
+        return grow["wall_ms"], profile
+    finally:
+        fleet.stop()
+
+
 def _make_detection_batch_fixed(rng, batch_size, boxes_per_image=4):
     """Detection batch with a FIXED box count per image.
 
@@ -1910,6 +2073,7 @@ def main() -> None:
         ("config8_multistream_samples_per_sec", _bench_multistream),
         ("config9_serve_ingest_records_per_sec", _bench_serve),
         ("config11_serve_fleet_ingest_records_per_sec", _bench_serve_fleet),
+        ("config12_fleet_resize_grow_wall_ms", _bench_fleet_resize),
         ("config10_mesh_ddp_samples_per_sec", _bench_mesh_ddp),
         ("device_mfu", _bench_mfu),
     ):
@@ -2035,6 +2199,26 @@ def main() -> None:
                 for key, val in result[1].items():
                     if key.startswith(("ingest_rps_w", "query_p50_ms_w", "query_p99_ms_w")):
                         extra[f"config11_serve_fleet_{key}"] = val
+            elif name.startswith("config12_fleet_resize"):
+                extra[name] = round(result[0], 3)
+                extra["config12_fleet_resize_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) carries the migration price and the zero-recompile
+                # proof for the post-resize steady state
+                for key in (
+                    "grow_wall_ms",
+                    "grow_rows_moved",
+                    "grow_rows_parked",
+                    "grow_drained",
+                    "shrink_wall_ms",
+                    "shrink_rows_moved",
+                    "shrink_rows_parked",
+                    "shrink_drained",
+                    "final_epoch",
+                    "steady_state_rps",
+                    "timed_recompiles",
+                ):
+                    extra[f"config12_fleet_resize_{key}"] = result[1][key]
             elif name.startswith("config9_serve"):
                 extra[name] = round(result[0], 1)
                 extra["config9_serve_profile"] = result[1]

@@ -93,6 +93,96 @@ def shard_checkpoint_directory(root: str, shard: int) -> str:
     return os.path.join(str(root), f"shard_{int(shard):04d}")
 
 
+# ---------------------------------------------------------------------------
+# Elastic span transfer: the PR-5 restore path specialized to row ranges.
+#
+# A fleet resize moves contiguous stream spans between shard workers.  The
+# payloads below are the wire format: the donor's row-range slice of every
+# stacked ``(S, ...)`` state (or, for a plain job, its whole encoded state)
+# packed with the checkpoint codec's blob packer and integrity-checked with
+# the same blake2b digest the manifest uses — a corrupted or truncated
+# transfer raises instead of silently seeding a recipient with garbage.
+# Everything is base64-JSON so the same payload rides the in-process handle
+# and the worker HTTP surface unchanged.
+# ---------------------------------------------------------------------------
+
+
+def encode_stream_span(metric: Metric, lo: int, hi: int) -> Dict[str, Any]:
+    """Pack rows ``[lo, hi)`` of a multistream metric's stacked states.
+
+    Returns a jsonable payload ``{"lo", "hi", "rows", "blob", "digest"}``;
+    ``rows`` is the slice's accepted-row total (the recipient's update-count
+    credit), ``digest`` guards the packed bytes end to end.
+    """
+    import base64
+
+    from metrics_tpu.metric import _pack_state_blob
+
+    arrays = metric.stream_slice(lo, hi)
+    blob = _pack_state_blob(arrays)
+    rows_vec = arrays.get("stream_rows")
+    return {
+        "lo": int(lo),
+        "hi": int(hi),
+        "rows": int(rows_vec.sum()) if rows_vec is not None else 0,
+        "blob": base64.b64encode(blob).decode("ascii"),
+        "digest": codec.state_digest(blob),
+    }
+
+
+def decode_stream_span(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Verify and unpack one :func:`encode_stream_span` payload.
+
+    Returns ``{key: np.ndarray}`` slice arrays for
+    :meth:`MultiStreamMetric.adopt_stream_slice`; raises
+    :class:`CheckpointIntegrityError` when the digest does not match.
+    """
+    import base64
+
+    from metrics_tpu.metric import _unpack_state_blob
+
+    blob = base64.b64decode(payload["blob"])
+    expect = payload.get("digest")
+    if codec.state_digest(blob) != expect:
+        raise CheckpointIntegrityError(
+            f"stream span [{payload.get('lo')}, {payload.get('hi')}) failed "
+            "its transfer digest; refusing to seed the recipient"
+        )
+    return _unpack_state_blob(blob)
+
+
+def encode_metric_transfer(metric: Metric) -> Dict[str, Any]:
+    """Pack a whole metric (plain-job migration) as a jsonable payload."""
+    import base64
+
+    encoded = codec.encode_metric(metric)
+    return {
+        "blob": base64.b64encode(encoded.blob).decode("ascii"),
+        "digests": dict(encoded.digests),
+        "update_count": int(encoded.update_count),
+    }
+
+
+def apply_metric_transfer(metric: Metric, payload: Dict[str, Any]) -> None:
+    """Load one :func:`encode_metric_transfer` payload into a fresh metric.
+
+    The primary-shard restore path bit-for-bit: decode with digest
+    verification, rebuild the state pytree, load it.  Any failed state is a
+    hard error — migration moves live state between healthy workers, so
+    unlike a disk restore there is no "better stale than dead" policy.
+    """
+    import base64
+
+    blob = base64.b64decode(payload["blob"])
+    decoded = codec.decode_metric(blob, dict(payload["digests"]))
+    if decoded.failed:
+        raise CheckpointIntegrityError(
+            f"metric transfer failed digest check for state(s) "
+            f"{sorted(decoded.failed)}"
+        )
+    metric.load_state_pytree(codec.arrays_to_pytree(metric, decoded.arrays))
+
+
 def _step_dir(step: int) -> str:
     return f"step_{step:08d}"
 

@@ -578,6 +578,84 @@ class MultiStreamMetric(Metric):
         self._flush_pending()
         return int(np.asarray(self._state[self._DROPPED_STATE]))
 
+    # -------------------------------------------------------- span migration
+    def stream_slice(self, lo: int, hi: int) -> Dict[str, np.ndarray]:
+        """Host copies of rows ``[lo, hi)`` of every stacked state leaf.
+
+        Every ``(num_streams, ...)`` leaf — base tensors, stacked sketch
+        leaves, and the ``stream_rows`` bookkeeping vector — is sliced by
+        its stream axis; scalar state (``stream_dropped``, a per-shard
+        diagnostic) stays behind.  This is the donor half of an elastic
+        span migration: the returned dict round-trips through
+        :meth:`adopt_stream_slice` on a recipient metric at a different
+        width, landing each global stream's state at a new local row.
+        """
+        lo, hi = int(lo), int(hi)
+        if not 0 <= lo < hi <= self.num_streams:
+            raise MetricsTPUUserError(
+                f"stream_slice needs 0 <= lo < hi <= {self.num_streams}, "
+                f"got [{lo}, {hi})"
+            )
+        self._flush_pending()
+        self._flush_host_buffers()
+        out: Dict[str, np.ndarray] = {}
+        for key, value in self._state.items():
+            arr = np.asarray(value)
+            if arr.ndim and arr.shape[0] == self.num_streams:
+                out[key] = np.array(arr[lo:hi], copy=True)
+        return out
+
+    def adopt_stream_slice(self, lo: int, arrays: Dict[str, Any]) -> int:
+        """Write a donor's :meth:`stream_slice` into local rows starting at
+        ``lo``.  Returns the number of rows adopted.
+
+        Row assignment (not a fold): each global stream's full state lives
+        on exactly one donor, so placing the rows reproduces the donor's
+        accumulation bit-for-bit — the single-donor specialization of the
+        ``merge_state`` elastic fold, which is what keeps a resized fleet's
+        ``compute_all`` bitwise-identical to a never-resized one.
+        """
+        if not arrays:
+            return 0
+        lo = int(lo)
+        widths = {np.asarray(a).shape[0] for a in arrays.values()}
+        if len(widths) != 1:
+            raise MetricsTPUUserError(
+                f"ragged stream slice: row counts {sorted(widths)} disagree"
+            )
+        n = widths.pop()
+        if not 0 <= lo <= lo + n <= self.num_streams:
+            raise MetricsTPUUserError(
+                f"slice rows [{lo}, {lo + n}) fall outside this metric's "
+                f"[0, {self.num_streams}) stream axis"
+            )
+        self._flush_pending()
+        self._flush_host_buffers()
+        for key in arrays:
+            if key not in self._state:
+                raise MetricsTPUUserError(
+                    f"slice carries unknown state {key!r}; donor and "
+                    "recipient must run the same metric schema"
+                )
+        rows = 0
+        for key, arr in arrays.items():
+            live = jnp.asarray(self._state[key])
+            patch = jnp.asarray(np.asarray(arr), live.dtype)
+            if patch.shape[1:] != live.shape[1:]:
+                raise MetricsTPUUserError(
+                    f"slice state {key!r} has per-stream shape "
+                    f"{patch.shape[1:]}, metric expects {live.shape[1:]}"
+                )
+            self._state[key] = live.at[lo : lo + n].set(patch)
+            if key == self._ROWS_STATE:
+                rows = int(np.asarray(patch).sum())
+        # adopted rows were never part of a gathered sync prefix, and any
+        # cached compute predates them
+        self._delta_cache.clear()
+        self._computed = None
+        self._update_count += rows
+        return n
+
     # ------------------------------------------------------------------- misc
     def _state_spec(self, name: str, axis_name: str) -> Optional[PartitionSpec]:
         """Per-axis placement: every stacked ``(num_streams, ...)`` leaf —

@@ -299,7 +299,12 @@ def summarize_counters(
     if ckpt:
         out["ckpt"] = {k: int(v) for k, v in sorted(ckpt.items())}
     if serve:
-        out["serve"] = {k: int(v) for k, v in sorted(serve.items())}
+        # forwarder backoff is wall-clock seconds, the one float in the
+        # serve bucket (same treatment as sync's backoff_secs above)
+        out["serve"] = {
+            k: (round(v, 6) if k == "forwarder_backoff_secs" else int(v))
+            for k, v in sorted(serve.items())
+        }
     if iou_hits or iou_misses:
         out["iou_cache"] = {
             "hits": int(iou_hits),

@@ -33,6 +33,12 @@ checkpoint directory.  See ``docs/serving.md``.
 
 """
 
+from metrics_tpu.serve.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    FleetSignals,
+    autoscale_step,
+)
 from metrics_tpu.serve.columnar import ColumnRing
 from metrics_tpu.serve.coordinator import (
     FleetCoordinator,
@@ -56,11 +62,19 @@ from metrics_tpu.serve.ingest import (
     Record,
 )
 from metrics_tpu.serve.registry import EvalJob, MetricRegistry
-from metrics_tpu.serve.router import HashRing, ShardRouter
+from metrics_tpu.serve.router import (
+    HashRing,
+    MigrationPlan,
+    ShardRouter,
+    SpanMove,
+    migration_plan,
+)
 from metrics_tpu.serve.server import EvalServer, ServeConfig
 from metrics_tpu.serve.traffic import JobTraffic, TrafficGenerator, default_traffic
 
 __all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
     "BlockBatcher",
     "ColumnBatch",
     "ColumnRing",
@@ -69,6 +83,7 @@ __all__ = [
     "EvalJob",
     "EvalServer",
     "FleetCoordinator",
+    "FleetSignals",
     "FleetSpec",
     "HTTPShard",
     "HashRing",
@@ -79,14 +94,18 @@ __all__ = [
     "JobTraffic",
     "LocalFleet",
     "MetricRegistry",
+    "MigrationPlan",
     "PooledHTTPServer",
     "Record",
     "ServeConfig",
     "ShardRouter",
+    "SpanMove",
     "TrafficGenerator",
+    "autoscale_step",
     "build_shard_registry",
     "default_traffic",
     "make_fleet_http_server",
+    "migration_plan",
     "run_load",
     "run_process_load",
 ]

@@ -74,6 +74,7 @@ class ColumnRing:
         self._tail = 0  # first committed-unread slot
         self._count = 0  # buffered rows, pending included
         self._pending = 0  # rows handed to the drainer, not yet committed
+        self._hwm = 0  # deepest the ring has ever been (autoscaler signal)
 
     @property
     def arity(self) -> int:
@@ -82,6 +83,16 @@ class ColumnRing:
     def depth(self) -> int:
         with self._lock:
             return self._count
+
+    def pending(self) -> int:
+        """Rows handed to the drainer and not yet committed (in flight)."""
+        with self._lock:
+            return self._pending
+
+    def high_water(self) -> int:
+        """Deepest occupancy this ring has ever reached."""
+        with self._lock:
+            return self._hwm
 
     # ----------------------------------------------------------------- write
     def put(
@@ -138,6 +149,13 @@ class ColumnRing:
                 if split < n:
                     self._ids[: n - split] = ids[split:]
             self._count += n
+            if self._count > self._hwm:
+                # counter carries the delta so the summed counter IS the
+                # fleet-wide high-water mark (autoscaler pressure signal)
+                _obs.counter_inc(
+                    "serve.ring_occupancy_hwm", self._count - self._hwm
+                )
+                self._hwm = self._count
             self._readable.notify()
         return True
 
@@ -185,6 +203,11 @@ class ColumnRing:
                     f"commit({n}) does not match the outstanding drain "
                     f"({self._pending} row(s))"
                 )
+            if n < self._pending:
+                # the park lane: drained rows NOT released stay buffered and
+                # will be re-drained later (forward failure, held job,
+                # split-owner prefix) — commit(0) parks the whole drain
+                _obs.counter_inc("serve.parked_rows", self._pending - n)
             self._tail = (self._tail + n) % self.capacity
             self._count -= n
             self._pending = 0

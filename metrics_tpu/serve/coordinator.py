@@ -71,6 +71,7 @@ from metrics_tpu.obs import core as _obs
 from metrics_tpu.obs.exporters import prometheus_text
 from metrics_tpu.serve.columnar import ColumnRing
 from metrics_tpu.serve.httpd import _MAX_INGEST_BYTES, PooledHTTPServer
+from metrics_tpu.serve.router import migration_plan
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 __all__ = [
@@ -99,14 +100,42 @@ class HTTPShard:
     ``np.frombuffer``; no per-record objects on either side.
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 10.0,
+        retries: int = 2,
+        retry_backoff: float = 0.05,
+    ) -> None:
         self.base = f"http://{host}:{int(port)}"
         self.timeout = float(timeout)
+        self.retries = max(0, int(retries))
+        self.retry_backoff = float(retry_backoff)
 
     # ------------------------------------------------------------- plumbing
     def _get(self, path: str) -> Dict[str, Any]:
-        with urlopen(self.base + path, timeout=self.timeout) as resp:
-            return json.loads(resp.read().decode())
+        """GET with bounded retry on CONNECTION failures only.
+
+        The read endpoints are idempotent, so a refused/reset connection
+        (worker mid-restart or mid-resize) earns ``retries`` linear-backoff
+        attempts before the error surfaces — one blip must not flip the
+        fleet health rollup to degraded.  An ``HTTPError`` means the worker
+        answered; replaying cannot change a 4xx/5xx, so it raises at once.
+        """
+        attempt = 0
+        while True:
+            try:
+                with urlopen(self.base + path, timeout=self.timeout) as resp:
+                    return json.loads(resp.read().decode())
+            except HTTPError:
+                raise
+            except (URLError, OSError):
+                if attempt >= self.retries:
+                    raise
+                attempt += 1
+                _obs.counter_inc("serve.shard_retries")
+                time.sleep(self.retry_backoff * attempt)
 
     def _post(self, path: str, body: bytes, content_type: str) -> Tuple[int, Dict[str, Any]]:
         req = Request(
@@ -221,6 +250,54 @@ class HTTPShard:
             )
         return int(payload["step"])
 
+    # ------------------------------------------------------ elastic resize
+    def _post_json(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        status, out = self._post(
+            path, json.dumps(payload).encode(), "application/json"
+        )
+        if status != 200:
+            raise MetricsTPUUserError(
+                f"shard {self.base} {path} failed: HTTP {status} {out}"
+            )
+        return out
+
+    def migrate_out(
+        self, job: str, lo: Optional[int] = None, hi: Optional[int] = None
+    ) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"job": job}
+        if lo is not None:
+            body["lo"], body["hi"] = int(lo), int(hi)
+        return self._post_json("/migrate_out", body)
+
+    def migrate_in(
+        self,
+        job: str,
+        width: Optional[int] = None,
+        span_lo: int = 0,
+        pieces: Sequence[Dict[str, Any]] = (),
+        plain: bool = False,
+    ) -> int:
+        out = self._post_json(
+            "/migrate_in",
+            {
+                "job": job,
+                "width": width,
+                "span_lo": int(span_lo),
+                "pieces": list(pieces),
+                "plain": bool(plain),
+            },
+        )
+        return int(out.get("adopted", 0))
+
+    def commit_migration(self, job: str) -> None:
+        self._post_json("/migrate_commit", {"job": job})
+
+    def discard_migration(self, job: str) -> None:
+        self._post_json("/migrate_commit", {"job": job, "discard": True})
+
+    def retire_job(self, job: str) -> None:
+        self._post_json("/retire_job", {"job": job})
+
 
 class FleetCoordinator:
     """Routes ingest to shard rings and merges scatter-gather reads.
@@ -244,6 +321,8 @@ class FleetCoordinator:
         router: Any,
         handles: Sequence[Any],
         respawn: Optional[Callable[[int], Any]] = None,
+        provision: Optional[Callable[[int, Any], Any]] = None,
+        retire: Optional[Callable[[int], None]] = None,
         ring_capacity: int = 8192,
         ingest_dtype: Any = np.float32,
         query_timeout: float = 30.0,
@@ -256,6 +335,8 @@ class FleetCoordinator:
         self.router = router
         self._handles: List[Any] = list(handles)
         self._respawn = respawn
+        self._provision = provision
+        self._retire = retire
         self.ring_capacity = int(ring_capacity)
         self.ingest_dtype = np.dtype(ingest_dtype)
         self.query_timeout = float(query_timeout)
@@ -265,18 +346,30 @@ class FleetCoordinator:
             self._rings_lock.witness_name = "FleetCoordinator._rings_lock"
         except AttributeError:
             pass
+        # generous cap + lazy thread creation: scatter width survives grows
+        # without ever rebuilding the pool mid-flight
         self._pool = ThreadPoolExecutor(
-            max_workers=max(2, len(self._handles)),
+            max_workers=max(16, 2 * len(self._handles)),
             thread_name_prefix="fleet-scatter",
         )
         self._stop = threading.Event()
-        self._forwarders: List[threading.Thread] = []
+        self._forwarders: Dict[int, threading.Thread] = {}
         self._started = False
+        # jobs whose spans are mid-migration: forwarders park their rows
+        self._held_jobs: frozenset = frozenset()
+        # set <=> no resize in flight; flush() and queries gate on it
+        self._resize_done = threading.Event()
+        self._resize_done.set()
+        self._resize_claim = threading.Lock()
+        try:
+            self._resize_claim.witness_name = "FleetCoordinator._resize_claim"
+        except AttributeError:
+            pass
 
     # ---------------------------------------------------------------- lifecycle
     @property
     def num_shards(self) -> int:
-        return len(self._handles)
+        return self.router.num_shards
 
     def handle(self, shard: int) -> Any:
         return self._handles[int(shard)]
@@ -287,21 +380,24 @@ class FleetCoordinator:
             return self
         self._started = True
         for shard in range(self.num_shards):
-            t = threading.Thread(
-                target=self._forward_loop,
-                args=(shard,),
-                name=f"fleet-forward-{shard}",
-                daemon=True,
-            )
-            t.start()
-            self._forwarders.append(t)
+            self._spawn_forwarder(shard)
         return self
+
+    def _spawn_forwarder(self, shard: int) -> None:
+        t = threading.Thread(
+            target=self._forward_loop,
+            args=(shard,),
+            name=f"fleet-forward-{shard}",
+            daemon=True,
+        )
+        t.start()
+        self._forwarders[shard] = t
 
     def stop(self) -> None:
         self._stop.set()
-        for t in self._forwarders:
+        for t in self._forwarders.values():
             t.join(timeout=5.0)
-        self._forwarders = []
+        self._forwarders = {}
         self._pool.shutdown(wait=False)
 
     # ------------------------------------------------------------------ ingest
@@ -348,11 +444,17 @@ class FleetCoordinator:
                 raise MetricsTPUUserError(
                     f"job {job!r} is multistream; ingest needs stream_ids"
                 )
-            parts = self.router.partition_ids(job, stream_ids)
+            router = self.router
+            parts = router.partition_ids(job, stream_ids)
+            ids64 = np.asarray(stream_ids, np.int64).reshape(-1)
             accepted = rejected = 0
-            for shard, (positions, local_ids) in parts.items():
+            for shard, (positions, _local_ids) in parts.items():
                 ring = self._ring(shard, job, len(cols), with_ids=True)
-                ok = ring.put([c[positions] for c in cols], local_ids)
+                # rings stage GLOBAL stream ids: the shard key is only an
+                # affinity hint, and the forwarder re-resolves each row's
+                # owner at ship time — so rows parked across an elastic
+                # resize drain to the post-flip owner automatically
+                ok = ring.put([c[positions] for c in cols], ids64[positions])
                 if ok:
                     accepted += int(positions.shape[0])
                 else:
@@ -404,7 +506,16 @@ class FleetCoordinator:
             )
             accepted, rejected = self.ingest_columns(job, cols, ids)
             return accepted, rejected + missing
-        # slow path: nested array values keep per-record framing
+        # slow path: nested array values keep per-record framing.  This
+        # path ships straight to a worker (no ring to park in), so a job
+        # mid-migration rejects the whole batch — backpressure, never a
+        # row landing on a donor whose span already moved
+        if job in self._held_jobs:
+            n_held = len(records)
+            _obs.counter_inc(
+                "serve.records_rejected", n_held, reason="migration"
+            )
+            return 0, n_held + missing
         by_shard: Dict[int, List[Tuple[Tuple[Any, ...], Optional[int]]]] = {}
         for values, sid in records:
             if multistream:
@@ -427,46 +538,98 @@ class FleetCoordinator:
         return accepted, rejected + missing
 
     def _forward_loop(self, shard: int) -> None:
-        """Drain this shard's rings and ship views to the worker.
+        """Drain this shard's rings and ship views to each row's OWNER.
+
+        Rings stage global stream ids, and ownership is re-resolved against
+        the live router at ship time: the ring's shard key is only the
+        affinity the rows were staged under.  Each drain ships its maximal
+        single-owner *prefix* (arrival order is preserved, so parked rows
+        never leapfrog) — after an epoch flip the very same parked rows
+        drain to their new owner with no re-staging.
 
         A worker that rejects (429) or errors leaves the rows parked in
         the ring — ``commit(0)`` releases the drain without consuming, so
         the same rows retry after backoff (and survive a failover: the
-        replacement handle picks them up on the next pass).
+        replacement handle picks them up on the next pass).  Jobs held by
+        an in-flight resize are skipped whole.
 
         Idle waits back off geometrically (5ms up to 80ms): a quiescent
         fleet must not have N forwarder threads waking every few
         milliseconds and stealing scheduler slices from query threads;
         the first batch after an idle stretch waits at most the cap,
-        which forwarding (asynchronous by design) absorbs.
+        which forwarding (asynchronous by design) absorbs.  Backoff time
+        spent after a failed pass accumulates in
+        ``serve.forwarder_backoff_secs`` (autoscaler pressure signal).
+        A forwarder whose shard left the fleet (shrink) exits once its
+        rings run dry.
         """
         idle_wait = _FORWARD_POLL_S
         while not self._stop.is_set():
             moved = False
+            errored = False
+            router = self.router
+            held = self._held_jobs
             for job, ring in self._shard_rings(shard):
+                if job in held:
+                    continue
                 got = ring.drain(timeout=0.0)
                 if got is None:
                     continue
                 views, id_view, n = got
                 try:
-                    ok = self._handles[shard].ingest_columns(job, views, id_view)
-                except (OSError, URLError):
+                    if id_view is not None:
+                        owners = router.owner_of_ids(job, id_view)
+                        # maximal single-owner prefix: argmax finds the
+                        # first owner change (fixed-shape, no nonzero)
+                        mixed = owners != owners[0]
+                        p = int(np.argmax(mixed)) if bool(mixed.any()) else n
+                        target = int(owners[0])
+                        lo = router.span(job, target)[0]
+                        ship_ids = (
+                            id_view[:p].astype(np.int64) - lo
+                        ).astype(np.int32)
+                        ship_views = [v[:p] for v in views]
+                    else:
+                        p, target = n, router.owner(job)
+                        ship_ids, ship_views = None, views
+                    ok = self._handles[target].ingest_columns(
+                        job, ship_views, ship_ids
+                    )
+                except (OSError, URLError, IndexError):
+                    # IndexError: the router moved under us (shrink); the
+                    # rows park and re-route against the new epoch
                     ok = False
+                    p = 0
                 if ok:
-                    ring.commit(n)
+                    ring.commit(p)
                     _obs.counter_inc(
-                        "serve.fleet_rows_forwarded", n, shard=str(shard)
+                        "serve.fleet_rows_forwarded", p, shard=str(shard)
                     )
                     moved = True
                 else:
                     ring.commit(0)
+                    errored = True
                     _obs.counter_inc(
                         "serve.fleet_forward_errors", shard=str(shard)
                     )
             if moved:
                 idle_wait = _FORWARD_POLL_S
             else:
+                if (
+                    shard >= self.router.num_shards
+                    and not any(
+                        r.depth() for _j, r in self._shard_rings(shard)
+                    )
+                ):
+                    self._forwarders.pop(shard, None)
+                    return  # retired shard, rings dry: done for good
                 self._stop.wait(idle_wait)
+                if errored:
+                    _obs.counter_inc(
+                        "serve.forwarder_backoff_secs",
+                        idle_wait,
+                        shard=str(shard),
+                    )
                 idle_wait = min(idle_wait * 2, _FORWARD_IDLE_MAX_S)
 
     def staged_rows(self) -> int:
@@ -474,12 +637,28 @@ class FleetCoordinator:
         return sum(r.depth() for r in list(self._rings.values()))
 
     def flush(self, timeout: float = 10.0) -> bool:
-        """Wait for staging rings to drain, then flush every worker."""
+        """Wait for staging rings to drain, then flush every worker.
+
+        A flush that lands mid-resize WAITS for the migration (held rows
+        are parked on purpose and will drain after the epoch flip) instead
+        of reporting success with rows still parked; ``False`` means the
+        deadline passed with rows still in flight, never that rows were
+        forgotten.
+        """
         deadline = time.monotonic() + float(timeout)
-        while self.staged_rows() > 0:
-            if time.monotonic() >= deadline:
+        while True:
+            # gate on the resize first: held rings cannot drain until the
+            # flip releases them, so polling depths alone would spin
+            if not self._resize_done.wait(
+                timeout=max(0.0, deadline - time.monotonic())
+            ):
                 return False
-            time.sleep(_FORWARD_POLL_S)
+            while self.staged_rows() > 0:
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(_FORWARD_POLL_S)
+            if self._resize_done.is_set():
+                break  # drained, and no new resize started meanwhile
         remaining = max(0.1, deadline - time.monotonic())
         results = self._scatter(
             "flush", lambda s, h: h.flush(remaining), count=False
@@ -492,16 +671,38 @@ class FleetCoordinator:
         what: str,
         fn: Callable[[int, Any], Any],
         count: bool = True,
+        router: Optional[Any] = None,
     ) -> Dict[int, Any]:
         if count:
             _obs.counter_inc("serve.scatter_queries", op=what)
+        width = (router or self.router).num_shards
         futures = {
-            s: self._pool.submit(fn, s, self._handles[s])
-            for s in range(self.num_shards)
+            s: self._pool.submit(fn, s, self._handles[s]) for s in range(width)
         }
         return {
             s: f.result(timeout=self.query_timeout) for s, f in futures.items()
         }
+
+    def _with_router(self, fn: Callable[[Any], Any]) -> Any:
+        """Run one scatter read against a router snapshot, retrying when an
+        elastic resize flips the epoch mid-read.
+
+        A read that raced the flip can see a worker answering for a span
+        it no longer (or does not yet) own; retrying against the new
+        snapshot makes the read linearize cleanly on one side of the flip.
+        Errors unrelated to a flip surface on the first attempt.
+        """
+        deadline = time.monotonic() + self.query_timeout
+        while True:
+            router = self.router
+            try:
+                return fn(router)
+            except Exception:
+                if router is self.router and self._resize_done.is_set():
+                    raise
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(_FORWARD_POLL_S)
 
     def top_k(
         self, job: str, k: int, key: Any = None, largest: bool = True
@@ -521,28 +722,33 @@ class FleetCoordinator:
             raise MetricsTPUUserError(
                 f"top_k k must be in [1, {total}], got {k}"
             )
-        per = self._scatter(
-            "top_k",
-            lambda s, h: h.top_k(
-                job,
-                min(k, self.router.span_width(job, s)),
-                key=key,
-                largest=largest,
-            ),
-        )
-        fill = -math.inf if largest else math.inf
-        candidates: List[Tuple[float, int, float]] = []
-        for shard, (values, local_ids) in per.items():
-            lo, _hi = self.router.span(job, shard)
-            for value, local in zip(values, local_ids):
-                value = float(value)
-                score = fill if math.isnan(value) else value
-                candidates.append((score, lo + int(local), value))
-        candidates.sort(
-            key=lambda c: ((-c[0] if largest else c[0]), c[1])
-        )
-        top = candidates[:k]
-        return [v for _s, _g, v in top], [g for _s, g, _v in top]
+
+        def _run(router: Any) -> Tuple[List[float], List[int]]:
+            per = self._scatter(
+                "top_k",
+                lambda s, h: h.top_k(
+                    job,
+                    min(k, router.span_width(job, s)),
+                    key=key,
+                    largest=largest,
+                ),
+                router=router,
+            )
+            fill = -math.inf if largest else math.inf
+            candidates: List[Tuple[float, int, float]] = []
+            for shard, (values, local_ids) in per.items():
+                lo, _hi = router.span(job, shard)
+                for value, local in zip(values, local_ids):
+                    value = float(value)
+                    score = fill if math.isnan(value) else value
+                    candidates.append((score, lo + int(local), value))
+            candidates.sort(
+                key=lambda c: ((-c[0] if largest else c[0]), c[1])
+            )
+            top = candidates[:k]
+            return [v for _s, _g, v in top], [g for _s, g, _v in top]
+
+        return self._with_router(_run)
 
     def where(
         self, job: str, op: str, threshold: float, k: int, key: Any = None
@@ -554,34 +760,49 @@ class FleetCoordinator:
         one worker over the whole axis.
         """
         k = int(k)
-        per = self._scatter(
-            "where",
-            lambda s, h: h.where(
-                job,
-                op,
-                threshold,
-                min(k, self.router.span_width(job, s)),
-                key=key,
-            ),
-        )
-        gids: List[int] = []
-        total = 0
-        for shard in sorted(per):
-            local_ids, matches = per[shard]
-            lo, _hi = self.router.span(job, shard)
-            gids.extend(lo + int(i) for i in local_ids)
-            total += int(matches)
-        return gids[:k], total
+
+        def _run(router: Any) -> Tuple[List[int], int]:
+            per = self._scatter(
+                "where",
+                lambda s, h: h.where(
+                    job,
+                    op,
+                    threshold,
+                    min(k, router.span_width(job, s)),
+                    key=key,
+                ),
+                router=router,
+            )
+            gids: List[int] = []
+            total = 0
+            for shard in sorted(per):
+                local_ids, matches = per[shard]
+                lo, _hi = router.span(job, shard)
+                gids.extend(lo + int(i) for i in local_ids)
+                total += int(matches)
+            return gids[:k], total
+
+        return self._with_router(_run)
 
     def compute(self, job: str) -> Any:
         """One job's full value: owner read (plain) or span concat
         (multistream) — the stream axis reassembles in global order."""
         if not self.router.is_multistream(job):
-            owner = self.router.owner(job)
             _obs.counter_inc("serve.scatter_queries", op="compute")
-            return self._handles[owner].compute(job)
-        per = self._scatter("compute", lambda s, h: h.compute(job))
-        return _concat_streams([per[s] for s in sorted(per)])
+            return self._with_router(
+                lambda router: self._handles[router.owner(job)].compute(job)
+            )
+
+        def _run(router: Any) -> Any:
+            per = self._scatter(
+                "compute", lambda s, h: h.compute(job), router=router
+            )
+            total = router.num_streams(job)
+            merged = _concat_streams([per[s] for s in sorted(per)])
+            _require_width(merged, total)
+            return merged
+
+        return self._with_router(_run)
 
     def compute_streams(self, job: str, stream_ids: Sequence[int]) -> List[Any]:
         """Per-stream reads reassembled in the caller's input order."""
@@ -593,19 +814,25 @@ class FleetCoordinator:
                 f"{[int(i) for i in ids if i < 0 or i >= total]}"
             )
         _obs.counter_inc("serve.scatter_queries", op="compute_streams")
-        parts = self.router.partition_ids(job, ids)
-        futures = {
-            s: self._pool.submit(
-                self._handles[s].compute_streams, job, [int(i) for i in local]
-            )
-            for s, (_pos, local) in parts.items()
-        }
-        out: List[Any] = [None] * int(ids.shape[0])
-        for s, (positions, _local) in parts.items():
-            values = futures[s].result(timeout=self.query_timeout)
-            for position, value in zip(positions, values):
-                out[int(position)] = value
-        return out
+
+        def _run(router: Any) -> List[Any]:
+            parts = router.partition_ids(job, ids)
+            futures = {
+                s: self._pool.submit(
+                    self._handles[s].compute_streams,
+                    job,
+                    [int(i) for i in local],
+                )
+                for s, (_pos, local) in parts.items()
+            }
+            out: List[Any] = [None] * int(ids.shape[0])
+            for s, (positions, _local) in parts.items():
+                values = futures[s].result(timeout=self.query_timeout)
+                for position, value in zip(positions, values):
+                    out[int(position)] = value
+            return out
+
+        return self._with_router(_run)
 
     def compute_all(self) -> Dict[str, Any]:
         """Every routed job's value, shards merged (the fleet-wide answer
@@ -616,12 +843,13 @@ class FleetCoordinator:
     def health(self) -> Dict[str, Any]:
         """Per-shard probe rollup; ``status`` is ``"serving"`` only when
         every shard is."""
+        router = self.router
+        width = router.num_shards
         futures = {
-            s: self._pool.submit(self._handles[s].health)
-            for s in range(self.num_shards)
+            s: self._pool.submit(self._handles[s].health) for s in range(width)
         }
         shards: List[Dict[str, Any]] = []
-        for s in range(self.num_shards):
+        for s in range(width):
             try:
                 info = futures[s].result(timeout=self.query_timeout)
             except Exception as err:  # noqa: BLE001 — a dead worker is data, not a crash
@@ -630,7 +858,9 @@ class FleetCoordinator:
         dead = [s for s, info in enumerate(shards) if info.get("status") != "serving"]
         return {
             "status": "serving" if not dead else "degraded",
-            "num_shards": self.num_shards,
+            "num_shards": width,
+            "epoch": int(getattr(router, "epoch", 0)),
+            "resizing": not self._resize_done.is_set(),
             "dead_shards": dead,
             "staged_rows": self.staged_rows(),
             "shards": shards,
@@ -657,6 +887,245 @@ class FleetCoordinator:
         self._handles[shard] = replacement
         _obs.counter_inc("serve.failovers", shard=str(shard))
         return replacement
+
+    # ---------------------------------------------------------------- elastic
+    def ring_stats(self) -> Dict[str, Any]:
+        """Occupancy snapshot of every staging ring — the autoscaler's
+        input signal (and an operator debugging read)."""
+        rings = [
+            {
+                "shard": s,
+                "job": job,
+                "depth": ring.depth(),
+                "pending": ring.pending(),
+                "high_water": ring.high_water(),
+            }
+            for (s, job), ring in sorted(self._rings.items())
+        ]
+        return {
+            "num_shards": self.router.num_shards,
+            "epoch": int(getattr(self.router, "epoch", 0)),
+            "ring_capacity": self.ring_capacity,
+            "rings": rings,
+            "staged_rows": sum(r["depth"] for r in rings),
+            "held_jobs": sorted(self._held_jobs),
+            "resizing": not self._resize_done.is_set(),
+        }
+
+    def resize(
+        self,
+        num_shards: int,
+        timeout: float = 60.0,
+        phase_hook: Optional[Callable[[str], None]] = None,
+    ) -> Dict[str, Any]:
+        """Live fleet resize: migrate every span that changes owner, then
+        flip the router to a new epoch — ingest and queries keep flowing.
+
+        The protocol (each boundary reported to ``phase_hook``):
+
+        1. **planned** — build the target router and the minimal
+           :func:`~metrics_tpu.serve.router.migration_plan` between epochs.
+        2. **provisioned** — fresh workers for added shards (grow only).
+        3. **held** — forwarders stop shipping the affected jobs; their
+           rows park in the staging rings (whole-batch backpressure is the
+           only degradation), and the one drain per ring that may already
+           be in flight is waited out.
+        4. **quiesced** — every old worker flushes, so queued rows are in
+           the exported state.
+        5. **staged** — donors export each moving span (a pure read; they
+           keep serving), recipients assemble their post-resize metrics,
+           STAGED — nothing is live yet.
+        6. **flipped** — the handle list is extended, then the router
+           reference is swapped: one atomic store is the commit point.
+        7. **committed** — each affected worker swaps its staged metric
+           live (a pointer swap under the job lock), then the holds lift.
+        8. **released / drained** — parked rows re-resolve their owner
+           against the new epoch and drain; donor shards retire plain jobs
+           that moved away; shrink retires the departed workers.
+
+        Any failure BEFORE the flip aborts cleanly: exports were reads,
+        staged state is discarded, provisioned workers are retired, and
+        the old epoch keeps serving — the remedy is ``failover`` of the
+        failed shard and a fresh ``resize``.  Failure AFTER the flip is
+        ordinary failover territory for the shard that failed; the holds
+        for its jobs stay up (rows keep parking — safe, not silent) until
+        the operator resolves it.
+        """
+        n = int(num_shards)
+        if n < 1:
+            raise MetricsTPUUserError(f"num_shards must be >= 1, got {n}")
+        old_router = self.router
+        if n > old_router.num_shards and self._provision is None:
+            raise MetricsTPUUserError(
+                "growing the fleet needs a provision callback; construct "
+                "the coordinator with provision=..."
+            )
+        with self._resize_claim:
+            if not self._resize_done.is_set():
+                raise MetricsTPUUserError("a resize is already in flight")
+            self._resize_done.clear()
+        hook = phase_hook or (lambda _phase: None)
+        t0 = time.monotonic()
+        deadline = t0 + float(timeout)
+        staged_handles: Dict[int, Any] = {}
+        staged_imports: List[Tuple[int, Any, str]] = []
+        retire_plain: List[Tuple[int, str]] = []
+        flipped = False
+        try:
+            new_router = old_router.resized(n)
+            plan = migration_plan(old_router, new_router)
+            held = frozenset(plan.jobs())
+            hook("planned")
+            for shard in range(old_router.num_shards, n):
+                staged_handles[shard] = self._provision(shard, new_router)
+            hook("provisioned")
+            self._held_jobs = held
+            for (_s, job), ring in list(self._rings.items()):
+                if job not in held:
+                    continue
+                while ring.pending():
+                    if time.monotonic() >= deadline:
+                        raise MetricsTPUUserError(
+                            "resize timed out waiting for in-flight rows"
+                        )
+                    time.sleep(_FORWARD_POLL_S)
+            hook("held")
+            for shard in range(old_router.num_shards):
+                if not self._handles[shard].flush(
+                    max(0.1, deadline - time.monotonic())
+                ):
+                    raise MetricsTPUUserError(
+                        f"shard {shard} failed to quiesce for resize"
+                    )
+            hook("quiesced")
+            rows_moved = 0
+            for job in sorted(j for j in held if old_router.is_multistream(j)):
+                for recipient in range(n):
+                    new_lo, new_hi = new_router.span(job, recipient)
+                    if recipient < old_router.num_shards and old_router.span(
+                        job, recipient
+                    ) == (new_lo, new_hi):
+                        continue  # span unchanged: nothing to rebuild
+                    payloads: List[Dict[str, Any]] = []
+                    for donor in range(old_router.num_shards):
+                        old_lo, _old_hi = old_router.span(job, donor)
+                        lo = max(new_lo, old_lo)
+                        hi = min(new_hi, _old_hi)
+                        if lo >= hi:
+                            continue
+                        piece = self._handles[donor].migrate_out(
+                            job, lo - old_lo, hi - old_lo
+                        )
+                        # re-stamp donor-local row coordinates as GLOBAL:
+                        # the recipient places pieces by global row
+                        payloads.append(dict(piece, lo=int(lo), hi=int(hi)))
+                        if donor != recipient:
+                            rows_moved += hi - lo
+                    handle = staged_handles.get(recipient)
+                    if handle is None:
+                        handle = self._handles[recipient]
+                    handle.migrate_in(
+                        job,
+                        width=new_hi - new_lo,
+                        span_lo=new_lo,
+                        pieces=payloads,
+                    )
+                    staged_imports.append((recipient, handle, job))
+            for move in plan.moves:
+                if not move.plain:
+                    continue
+                piece = self._handles[move.donor].migrate_out(move.job)
+                handle = staged_handles.get(move.recipient)
+                if handle is None:
+                    handle = self._handles[move.recipient]
+                handle.migrate_in(move.job, pieces=[piece], plain=True)
+                staged_imports.append((move.recipient, handle, move.job))
+                retire_plain.append((move.donor, move.job))
+            hook("staged")
+            if n > old_router.num_shards:
+                self._handles = list(self._handles) + [
+                    staged_handles[s]
+                    for s in range(old_router.num_shards, n)
+                ]
+            self.router = new_router  # THE commit point (atomic store)
+            flipped = True
+            hook("flipped")
+            for _shard, handle, job in staged_imports:
+                handle.commit_migration(job)
+            hook("committed")
+            self._held_jobs = frozenset()
+            if self._started:
+                for shard in range(old_router.num_shards, n):
+                    self._spawn_forwarder(shard)
+            hook("released")
+            for donor, job in retire_plain:
+                if donor < n:
+                    self._handles[donor].retire_job(job)
+            drained = True
+            while any(
+                r.depth()
+                for (_s, j), r in list(self._rings.items())
+                if j in held
+            ):
+                if time.monotonic() >= deadline:
+                    drained = False  # rows are parked, not lost: keep going
+                    break
+                time.sleep(_FORWARD_POLL_S)
+            hook("drained")
+            if n < old_router.num_shards:
+                if self._retire is not None:
+                    for shard in range(n, old_router.num_shards):
+                        self._retire(shard)
+                self._handles = list(self._handles)[:n]
+            _obs.counter_inc("serve.resizes")
+            if rows_moved:
+                _obs.counter_inc("serve.resize_rows_moved", rows_moved)
+            return {
+                "epoch": int(new_router.epoch),
+                "old_shards": old_router.num_shards,
+                "new_shards": n,
+                "moves": len(plan.moves),
+                "rows_moved": rows_moved,
+                "jobs": sorted(held),
+                "drained": drained,
+                "wall_secs": round(time.monotonic() - t0, 6),
+            }
+        except BaseException:
+            if not flipped:
+                # clean abort: exports were pure reads and nothing staged
+                # went live — lift the holds, drop staged state, tear down
+                # provisioned workers; the old epoch keeps serving
+                self._held_jobs = frozenset()
+                for _shard, handle, job in staged_imports:
+                    try:
+                        handle.discard_migration(job)
+                    except Exception:  # noqa: BLE001 — the worker may be the casualty
+                        pass
+                if self._retire is not None:
+                    for shard in staged_handles:
+                        try:
+                            self._retire(shard)
+                        except Exception:  # noqa: BLE001
+                            pass
+            _obs.counter_inc("serve.resize_failures")
+            raise
+        finally:
+            self._resize_done.set()
+
+
+def _require_width(merged: Any, total: int) -> None:
+    """Raise when a merged multistream compute does not cover exactly the
+    global stream axis — the signature of a read racing an epoch flip (a
+    worker answered with its pre-commit span width); the caller's router
+    retry then re-reads against a settled fleet."""
+    if isinstance(merged, dict):
+        for value in merged.values():
+            _require_width(value, total)
+        return
+    if isinstance(merged, list) and len(merged) != total:
+        raise MetricsTPUUserError(
+            f"merged stream axis has {len(merged)} row(s), expected {total}"
+        )
 
 
 def _concat_streams(parts: List[Any]) -> Any:

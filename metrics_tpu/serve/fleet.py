@@ -206,6 +206,45 @@ class InProcessShard:
     def checkpoint(self) -> int:
         return self.server.checkpoint_now()
 
+    # ------------------------------------------------------------- migration
+    def _require_live(self) -> None:
+        # the HTTP handle gets connection-refused from a dead worker; the
+        # in-process handle must fail the same way, so a kill mid-migration
+        # aborts the resize instead of silently reading a corpse's registry
+        if self.server._stopped:
+            raise MetricsTPUUserError("shard worker is stopped")
+
+    def migrate_out(
+        self, job: str, lo: Optional[int] = None, hi: Optional[int] = None
+    ) -> Dict[str, Any]:
+        self._require_live()
+        return self.server.export_span(job, lo=lo, hi=hi)
+
+    def migrate_in(
+        self,
+        job: str,
+        width: Optional[int] = None,
+        span_lo: int = 0,
+        pieces: Sequence[Dict[str, Any]] = (),
+        plain: bool = False,
+    ) -> int:
+        self._require_live()
+        return self.server.import_span(
+            job, width=width, span_lo=span_lo, pieces=tuple(pieces), plain=plain
+        )
+
+    def commit_migration(self, job: str) -> None:
+        self._require_live()
+        self.server.commit_migration(job)
+
+    def discard_migration(self, job: Optional[str] = None) -> int:
+        # abort path: stays callable on a dead worker (nothing to undo there)
+        return self.server.discard_migration(job)
+
+    def retire_job(self, job: str) -> None:
+        self._require_live()
+        self.server.retire_job(job)
+
 
 class LocalFleet:
     """N in-process workers + one coordinator (tests and the bench).
@@ -236,6 +275,8 @@ class LocalFleet:
             self.router,
             handles,
             respawn=self._respawn,
+            provision=self._provision,
+            retire=self._retire_shard,
             ring_capacity=self.spec.ring_capacity,
             ingest_dtype=self.spec.ingest_dtype,
             query_timeout=self.spec.query_timeout,
@@ -252,13 +293,18 @@ class LocalFleet:
             max_staleness=self.spec.max_staleness,
         )
 
-    def _spawn_server(self, shard: int) -> EvalServer:
-        registry = build_shard_registry(self.spec, shard, self.router)
+    def _spawn_server(
+        self, shard: int, router: Optional[ShardRouter] = None
+    ) -> EvalServer:
+        registry = build_shard_registry(
+            self.spec, shard, self.router if router is None else router
+        )
         config = replace(self.spec.server_config, port=0)
         server = EvalServer(
             registry,
             config=config,
             checkpoint_manager=self._manager(shard),
+            builders={job.name: job for job in self.spec.jobs},
         )
         # restore-on-start: a respawn after kill_shard() picks the shard's
         # latest committed snapshot right back up
@@ -266,9 +312,34 @@ class LocalFleet:
         return server
 
     def _respawn(self, shard: int) -> InProcessShard:
-        server = self._spawn_server(shard)
+        # the coordinator's router is the live epoch (it may be ahead of
+        # the fleet copy while a resize is mid-flight or has failed)
+        router = (
+            self.router if self.coordinator is None else self.coordinator.router
+        )
+        server = self._spawn_server(shard, router=router)
         self._servers[shard] = server
         return InProcessShard(server)
+
+    def _provision(self, shard: int, router: ShardRouter) -> InProcessShard:
+        """Coordinator resize callback: stand up a fresh worker for a shard
+        a grow adds, registered at the NEW router's spans.  Its zero state
+        is replaced by ``migrate_in`` before any row is routed to it."""
+        shard = int(shard)
+        server = self._spawn_server(shard, router=router)
+        while len(self._servers) <= shard:
+            self._servers.append(None)
+        self._servers[shard] = server
+        return InProcessShard(server)
+
+    def _retire_shard(self, shard: int) -> None:
+        """Coordinator resize callback: stop a worker a shrink removes (or
+        a provisioned-then-aborted grow).  No final checkpoint — its state
+        already migrated out (or never held anything)."""
+        shard = int(shard)
+        if shard < len(self._servers) and self._servers[shard] is not None:
+            self._servers[shard].stop(final_checkpoint=False)
+            self._servers[shard] = None
 
     def server(self, shard: int) -> EvalServer:
         srv = self._servers[int(shard)]
@@ -294,6 +365,38 @@ class LocalFleet:
         if self.coordinator is None:
             raise MetricsTPUUserError("fleet is not started")
         return self.coordinator.failover(shard)
+
+    def resize(
+        self,
+        num_shards: int,
+        timeout: float = 60.0,
+        phase_hook: Optional[Callable[[str], None]] = None,
+    ) -> Dict[str, Any]:
+        """Live resize with a durability floor under the coordinator's
+        migration protocol: every shard checkpoints at the **quiesced**
+        phase — after the holds and flushes, before any state moves — so a
+        worker killed mid-migration loses nothing that ``failover`` plus a
+        retried resize cannot restore.  On success the fleet's spec and
+        router track the new epoch and a fresh checkpoint pins the resized
+        state."""
+        if self.coordinator is None:
+            raise MetricsTPUUserError("fleet is not started")
+        n = int(num_shards)
+        durable = self.spec.checkpoint_root is not None
+
+        def _hook(phase: str) -> None:
+            if phase == "quiesced" and durable:
+                self.checkpoint_all()
+            if phase_hook is not None:
+                phase_hook(phase)
+
+        summary = self.coordinator.resize(n, timeout=timeout, phase_hook=_hook)
+        self.spec = replace(self.spec, num_shards=n)
+        self.router = self.coordinator.router
+        del self._servers[n:]
+        if durable:
+            self.checkpoint_all()
+        return summary
 
     def stop(self, final_checkpoint: bool = False) -> None:
         if self.coordinator is not None:

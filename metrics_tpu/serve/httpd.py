@@ -229,6 +229,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._flush(parse_qs(url.query))
             elif url.path == "/checkpoint":
                 self._checkpoint()
+            elif url.path == "/migrate_out":
+                self._migrate_out()
+            elif url.path == "/migrate_in":
+                self._migrate_in()
+            elif url.path == "/migrate_commit":
+                self._migrate_commit()
+            elif url.path == "/retire_job":
+                self._retire_job()
             else:
                 self._fail(404, f"no route {url.path!r}")
         except MetricsTPUUserError as err:
@@ -437,6 +445,68 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server.eval_server
         step = srv.checkpoint_now()
         self._send_json(200, {"step": int(step)})
+
+    # ------------------------------------------------- elastic resize wire
+    def _json_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0 or length > _MAX_INGEST_BYTES:
+            raise MetricsTPUUserError(
+                f"endpoint needs a JSON body of 1..{_MAX_INGEST_BYTES} bytes"
+            )
+        try:
+            payload = json.loads(self.rfile.read(length).decode())
+        except (ValueError, UnicodeDecodeError) as err:
+            raise MetricsTPUUserError(f"body is not valid JSON: {err}")
+        if not isinstance(payload, dict) or not isinstance(payload.get("job"), str):
+            raise MetricsTPUUserError('body must be a JSON object with "job"')
+        return payload
+
+    def _migrate_out(self) -> None:
+        """Export migrating state for one job (coordinator resize, donor
+        side): a pure read — the donor keeps serving from its live state."""
+        srv = self.server.eval_server
+        payload = self._json_body()
+        out = srv.export_span(
+            payload["job"], lo=payload.get("lo"), hi=payload.get("hi")
+        )
+        _obs.counter_inc("serve.migrate_out_requests", job=payload["job"])
+        self._send_json(200, out)
+
+    def _migrate_in(self) -> None:
+        """Stage a job's post-resize metric from donor pieces (recipient
+        side).  Nothing goes live until ``/migrate_commit``."""
+        srv = self.server.eval_server
+        payload = self._json_body()
+        pieces = payload.get("pieces")
+        if not isinstance(pieces, list) or not pieces:
+            raise MetricsTPUUserError('migrate_in needs "pieces": [...]')
+        adopted = srv.import_span(
+            payload["job"],
+            width=payload.get("width"),
+            span_lo=int(payload.get("span_lo", 0)),
+            pieces=tuple(pieces),
+            plain=bool(payload.get("plain", False)),
+        )
+        self._send_json(200, {"job": payload["job"], "adopted": int(adopted)})
+
+    def _migrate_commit(self) -> None:
+        """Flip one job to its staged post-resize metric — or, with
+        ``"discard": true``, drop staged state (the coordinator's abort)."""
+        srv = self.server.eval_server
+        payload = self._json_body()
+        if payload.get("discard"):
+            dropped = srv.discard_migration(payload["job"])
+            self._send_json(200, {"job": payload["job"], "discarded": dropped})
+            return
+        srv.commit_migration(payload["job"])
+        self._send_json(200, {"job": payload["job"], "committed": True})
+
+    def _retire_job(self) -> None:
+        """Drop a job whose state migrated away (plain-job donor)."""
+        srv = self.server.eval_server
+        payload = self._json_body()
+        srv.retire_job(payload["job"])
+        self._send_json(200, {"job": payload["job"], "retired": True})
 
 
 def _as_int_list(arr: Any) -> List[int]:

@@ -451,11 +451,19 @@ class IngestConsumer:
 
     def _batcher_for(self, name: str) -> Optional[BlockBatcher]:
         batcher = self.batchers.get(name)
-        if batcher is None and name in self.registry:
-            # a job registered after the consumer came up still routes —
-            # only this (consumer) thread ever mutates the batcher map
+        if name not in self.registry:
+            if batcher is not None:
+                # the job was retired (elastic resize moved it away): drop
+                # the inert batcher — only this (consumer) thread ever
+                # mutates the map — so rows stop folding into dead state
+                del self.batchers[name]
+            return None
+        job = self.registry[name]
+        if batcher is None or batcher.job is not job:
+            # a job registered after the consumer came up (or re-registered
+            # with a fresh EvalJob by a migration commit) still routes
             batcher = self.batchers[name] = BlockBatcher(
-                self.registry[name], block_rows=self.block_rows
+                job, block_rows=self.block_rows
             )
         return batcher
 

@@ -56,11 +56,18 @@ class ColumnTraffic:
         arity: int = 2,
         num_streams: Optional[int] = None,
         seed: int = 0,
+        dyadic: bool = False,
     ) -> None:
         self.job = job
         self.arity = int(arity)
         self.num_streams = num_streams
         self.seed = int(seed)
+        # dyadic quantization (multiples of 1/8) makes float32 accumulation
+        # exact no matter how rows are grouped into blocks — required by
+        # drills that compare fleets with DIFFERENT shardings bitwise
+        # (same-sharding twins match without it: their block boundaries,
+        # and so their partial-sum trees, are identical anyway)
+        self.dyadic = bool(dyadic)
 
     def batch(
         self, lo: int, hi: int
@@ -74,6 +81,8 @@ class ColumnTraffic:
         cols = [
             rng.random(n, dtype=np.float32) for _ in range(self.arity)
         ]
+        if self.dyadic:
+            cols = [np.floor(c * 8.0).astype(np.float32) / 8.0 for c in cols]
         ids = None
         if self.num_streams is not None:
             ids = rng.integers(0, self.num_streams, n).astype(np.int32)

@@ -263,6 +263,43 @@ class MetricRegistry:
         _obs.counter_inc("serve.jobs_registered", metric=type(metric).__name__)
         return job
 
+    def rebind(self, name: str, metric: Metric) -> EvalJob:
+        """Swap a registered job's metric instance in place (elastic resize).
+
+        The migration commit point on a worker: the staged post-resize
+        metric replaces the live one under the job lock, so every reader
+        (compute, export, checkpoint encode, batcher flush) sees either the
+        old state or the new state, never a mix.  The same serve invariants
+        ``register`` stamps are re-forced on the incoming metric, and the
+        cached checkpoint target is invalidated so the next snapshot encodes
+        the new instance.
+        """
+        if not isinstance(metric, Metric):
+            raise MetricsTPUUserError(
+                f"job {name!r} needs a Metric instance, got {type(metric).__name__}"
+            )
+        job = self[name]
+        metric.sync_on_compute = False
+        metric.dist_sync_on_step = False
+        metric.lazy_updates = 0
+        with job.lock:
+            job.metric = metric
+            self._ckpt_target = None
+        return job
+
+    def unregister(self, name: str) -> EvalJob:
+        """Remove a job (shard retirement after its state migrated away).
+
+        Taken under the job lock so an in-flight read finishes against the
+        old instance; the cached checkpoint target is invalidated so later
+        snapshots stop encoding the departed job.
+        """
+        job = self[name]
+        with job.lock:
+            del self._jobs[name]
+            self._ckpt_target = None
+        return job
+
     # -------------------------------------------------------------- dict-ish
     def __getitem__(self, name: str) -> EvalJob:
         try:

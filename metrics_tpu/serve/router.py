@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import hashlib
 from bisect import bisect_right
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -39,7 +40,13 @@ from metrics_tpu.multistream.sharding import shard_spans
 from metrics_tpu.obs import core as _obs
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
-__all__ = ["HashRing", "ShardRouter"]
+__all__ = [
+    "HashRing",
+    "MigrationPlan",
+    "ShardRouter",
+    "SpanMove",
+    "migration_plan",
+]
 
 
 def _ring_point(key: str) -> int:
@@ -95,12 +102,18 @@ class ShardRouter:
         num_shards: int,
         streams_by_job: Dict[str, Optional[int]],
         vnodes: int = 64,
+        epoch: int = 0,
     ) -> None:
         self.num_shards = int(num_shards)
         if self.num_shards < 1:
             raise MetricsTPUUserError(
                 f"num_shards must be >= 1, got {num_shards}"
             )
+        # routing generation: bumped by resized(); queries and workers can
+        # tell "same layout rebuilt" from "layout actually changed"
+        self.epoch = int(epoch)
+        self._streams_by_job = dict(streams_by_job)
+        self._vnodes = int(vnodes)
         self.ring = HashRing(range(self.num_shards), vnodes=vnodes)
         self._spans: Dict[str, List[Tuple[int, int]]] = {}
         self._bounds: Dict[str, np.ndarray] = {}
@@ -232,3 +245,128 @@ class ShardRouter:
                 "serve.shard_routes", hi_i - lo_i, shard=str(shard)
             )
         return out
+
+    def owner_of_ids(self, job: str, stream_ids: np.ndarray) -> np.ndarray:
+        """Owning shard of each GLOBAL stream id, vectorized, counter-free.
+
+        The forwarder's ship-time lookup: rows staged under one routing
+        epoch must re-resolve their owner under whatever epoch is live when
+        they actually ship, so parked rows drain to the post-resize owner
+        automatically.  No counters here — :meth:`partition_ids` already
+        billed ``serve.shard_routes`` at ingest.
+        """
+        self._known(job)
+        if job in self._plain_owner:
+            raise MetricsTPUUserError(
+                f"plain job {job!r} does not partition by stream_id"
+            )
+        ids = np.asarray(stream_ids, np.int64).reshape(-1)
+        bounds = self._bounds[job]
+        return np.clip(
+            np.searchsorted(bounds, ids, side="right") - 1,
+            0,
+            self.num_shards - 1,
+        )
+
+    # ---------------------------------------------------------------- elastic
+    def resized(self, num_shards: int) -> "ShardRouter":
+        """A new router for the same jobs at a different fleet width.
+
+        Pure construction — the live router is untouched; the caller owns
+        the atomic swap.  The epoch increments so both sides of a resize
+        are distinguishable even when ``num_shards`` round-trips back.
+        """
+        return ShardRouter(
+            num_shards,
+            self._streams_by_job,
+            vnodes=self._vnodes,
+            epoch=self.epoch + 1,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Resize planning: the minimal state movement between two router epochs.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SpanMove:
+    """One contiguous global-stream span changing owner.
+
+    ``job`` is multistream; rows ``[lo, hi)`` of its stacked states move
+    from shard ``donor`` (old layout) to shard ``recipient`` (new layout).
+    For a plain job the whole metric moves and ``lo``/``hi`` are ``-1``.
+    """
+
+    job: str
+    lo: int
+    hi: int
+    donor: int
+    recipient: int
+
+    @property
+    def plain(self) -> bool:
+        return self.lo < 0
+
+
+@dataclass(frozen=True)
+class MigrationPlan:
+    """Everything that must move to go from ``old`` to ``new`` routing."""
+
+    old_shards: int
+    new_shards: int
+    moves: Tuple[SpanMove, ...]
+
+    def jobs(self) -> List[str]:
+        return sorted({m.job for m in self.moves})
+
+    def rows(self) -> int:
+        """Total multistream rows changing owner (plain moves excluded)."""
+        return sum(m.hi - m.lo for m in self.moves if not m.plain)
+
+
+def migration_plan(old: ShardRouter, new: ShardRouter) -> MigrationPlan:
+    """The minimal set of :class:`SpanMove` pieces between two routers.
+
+    Multistream jobs: intersect every new-layout span with every old-layout
+    span; each non-empty intersection whose owners differ is one contiguous
+    piece to move (`shard_spans` keeps spans sorted, so the intersection
+    sweep is linear).  Plain jobs: the consistent-hash ring only reassigns
+    jobs whose owner actually changed — growing inserts the new shard's
+    virtual nodes and steals ~1/N of the keyspace, everything else stays
+    put.
+    """
+    if sorted(old.jobs()) != sorted(new.jobs()):
+        raise MetricsTPUUserError(
+            "migration_plan needs the same job set on both routers; "
+            f"old={old.jobs()} new={new.jobs()}"
+        )
+    moves: List[SpanMove] = []
+    for job in old.jobs():
+        if old.is_multistream(job) != new.is_multistream(job):
+            raise MetricsTPUUserError(
+                f"job {job!r} changed multistream-ness between routers"
+            )
+        if not old.is_multistream(job):
+            d, r = old.owner(job), new.owner(job)
+            if d != r:
+                moves.append(SpanMove(job, -1, -1, d, r))
+            continue
+        if old.num_streams(job) != new.num_streams(job):
+            raise MetricsTPUUserError(
+                f"job {job!r} changed stream width between routers "
+                f"({old.num_streams(job)} -> {new.num_streams(job)})"
+            )
+        for recipient in range(new.num_shards):
+            new_lo, new_hi = new.span(job, recipient)
+            for donor in range(old.num_shards):
+                old_lo, old_hi = old.span(job, donor)
+                lo, hi = max(new_lo, old_lo), min(new_hi, old_hi)
+                if lo < hi and donor != recipient:
+                    moves.append(SpanMove(job, lo, hi, donor, recipient))
+    moves.sort(key=lambda m: (m.job, m.lo, m.recipient))
+    return MigrationPlan(
+        old_shards=old.num_shards,
+        new_shards=new.num_shards,
+        moves=tuple(moves),
+    )

@@ -32,7 +32,14 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
-from metrics_tpu.checkpoint.manager import CheckpointManager
+from metrics_tpu.checkpoint.manager import (
+    CheckpointManager,
+    apply_metric_transfer,
+    decode_stream_span,
+    encode_metric_transfer,
+    encode_stream_span,
+)
+from metrics_tpu.multistream import MultiStreamMetric
 from metrics_tpu.obs import core as _obs
 from metrics_tpu.serve.httpd import make_http_server
 from metrics_tpu.serve.ingest import (
@@ -77,12 +84,22 @@ class EvalServer:
         registry: MetricRegistry,
         config: Optional[ServeConfig] = None,
         checkpoint_manager: Optional[CheckpointManager] = None,
+        builders: Optional[Dict[str, Any]] = None,
     ) -> None:
         if len(registry) == 0:
             raise MetricsTPUUserError("EvalServer needs at least one registered job")
         self.registry = registry
         self.config = config or ServeConfig()
         self.manager = checkpoint_manager
+        # job name -> JobSpec-like (.build/.components/.export_top_k): how to
+        # construct a fresh metric when an elastic resize migrates a span in
+        self._builders: Dict[str, Any] = dict(builders or {})
+        self._staged: Dict[str, Any] = {}  # job -> post-resize metric, uncommitted
+        self._migrate_lock = threading.Lock()
+        try:
+            self._migrate_lock.witness_name = "EvalServer._migrate_lock"
+        except AttributeError:
+            pass
         self.queue = IngestQueue(capacity=self.config.queue_capacity)
         self.consumer = IngestConsumer(
             registry,
@@ -249,6 +266,127 @@ class EvalServer:
                 # keep serving, retry on the next poll
                 _obs.counter_inc("serve.checkpoint_failures")
                 self.consumer.record_error(f"checkpoint failed: {err}")
+
+    # ------------------------------------------------------- elastic resize
+    def export_span(
+        self, job: str, lo: Optional[int] = None, hi: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Pack migrating state for one job as a jsonable transfer payload.
+
+        Multistream jobs export the LOCAL row range ``[lo, hi)`` of their
+        stacked states; plain jobs export the whole metric (``lo``/``hi``
+        ignored).  The coordinator quiesces this worker (forwarder hold +
+        flush) first, but a defensive flush here keeps a direct export from
+        missing batcher-carried rows.  Exports are pure reads — an aborted
+        resize leaves the donor untouched.
+        """
+        self.flush()
+        ejob = self.registry[job]
+        with ejob.lock:
+            if ejob.is_multistream:
+                if lo is None or hi is None:
+                    raise MetricsTPUUserError(
+                        f"job {job!r} is multistream; export_span needs [lo, hi)"
+                    )
+                return encode_stream_span(ejob.metric, int(lo), int(hi))
+            return encode_metric_transfer(ejob.metric)
+
+    def import_span(
+        self,
+        job: str,
+        width: Optional[int] = None,
+        span_lo: int = 0,
+        pieces: Tuple[Dict[str, Any], ...] = (),
+        plain: bool = False,
+    ) -> int:
+        """Build this worker's POST-resize metric for ``job`` from transfer
+        payloads, staged but not live.
+
+        Multistream: a fresh ``MultiStreamMetric`` of the new span ``width``
+        is assembled from donor pieces; each piece's global ``[lo, hi)``
+        lands at local rows ``lo - span_lo``.  The pieces must tile the new
+        span exactly.  Plain: the donor's whole metric is decoded into a
+        fresh instance.  The staged metric only becomes live at
+        :meth:`commit_migration` — until then every query reads the
+        pre-resize state, so an aborted migration leaves no trace.
+        """
+        spec = self._builders.get(job)
+        if spec is None:
+            raise MetricsTPUUserError(
+                f"no builder for job {job!r}; this worker cannot host it"
+            )
+        if plain:
+            if len(pieces) != 1:
+                raise MetricsTPUUserError(
+                    f"plain job {job!r} migrates as exactly one piece, got "
+                    f"{len(pieces)}"
+                )
+            metric = spec.build()
+            apply_metric_transfer(metric, pieces[0])
+            adopted = 1
+        else:
+            if width is None or int(width) < 1:
+                raise MetricsTPUUserError(
+                    f"multistream import for {job!r} needs the new span width"
+                )
+            metric = MultiStreamMetric(spec.build(), num_streams=int(width))
+            covered = 0
+            for payload in sorted(pieces, key=lambda p: int(p["lo"])):
+                arrays = decode_stream_span(payload)
+                covered += metric.adopt_stream_slice(
+                    int(payload["lo"]) - int(span_lo), arrays
+                )
+            if covered != int(width):
+                raise MetricsTPUUserError(
+                    f"import for {job!r} covered {covered} of {width} rows; "
+                    "pieces must tile the new span exactly"
+                )
+            adopted = covered
+        with self._migrate_lock:
+            self._staged[job] = metric
+        _obs.counter_inc("serve.spans_imported", job=job)
+        return adopted
+
+    def commit_migration(self, job: str) -> None:
+        """Make the staged post-resize metric live (the worker-local half of
+        the epoch flip): an in-place pointer swap under the job lock for a
+        job this worker already hosts, or a fresh registration for a plain
+        job migrating IN."""
+        with self._migrate_lock:
+            staged = self._staged.pop(job, None)
+        if staged is None:
+            raise MetricsTPUUserError(f"no staged migration for job {job!r}")
+        if job in self.registry:
+            self.registry.rebind(job, staged)
+        else:
+            spec = self._builders[job]
+            self.registry.register(
+                job,
+                staged,
+                components=getattr(spec, "components", None),
+                export_top_k=getattr(spec, "export_top_k", 0),
+            )
+        _obs.counter_inc("serve.migrations_committed", job=job)
+
+    def discard_migration(self, job: Optional[str] = None) -> int:
+        """Drop staged state (abort path): the live registry was never
+        touched, so this is the whole rollback."""
+        with self._migrate_lock:
+            if job is not None:
+                dropped = 1 if self._staged.pop(job, None) is not None else 0
+            else:
+                dropped = len(self._staged)
+                self._staged.clear()
+        return dropped
+
+    def retire_job(self, job: str) -> None:
+        """Drop a job whose state migrated to another shard (plain-job
+        donor after the epoch flip).  The batcher map is consumer-owned, so
+        the inert batcher stays; with the job unregistered, any stray row
+        is counted unroutable instead of folding into dead state."""
+        self.flush()
+        self.registry.unregister(job)
+        _obs.counter_inc("serve.jobs_retired", job=job)
 
     # ----------------------------------------------------------------- health
     def health(self) -> Dict[str, Any]:

@@ -85,7 +85,12 @@ def run_worker(
             max_staleness=max_staleness,
         )
     server = EvalServer(
-        registry, config=spec.server_config, checkpoint_manager=manager
+        registry,
+        config=spec.server_config,
+        checkpoint_manager=manager,
+        # builders arm /migrate_in: a subprocess worker can adopt spans
+        # during an elastic resize just like an in-process shard
+        builders={job.name: job for job in spec.jobs},
     )
     return server.start()
 

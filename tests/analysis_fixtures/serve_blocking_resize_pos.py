@@ -1,0 +1,24 @@
+# serve-blocking positives: 3 findings expected
+# (1 banned-import + 2 blocking-call inside a router epoch flip — the
+# resize commit point must stay a single atomic store, never a stall)
+import metrics_tpu.checkpoint  # banned-import: durability machinery
+
+
+class ElasticCoordinator:
+    """A resize whose epoch flip blocks on the cluster — exactly what the
+    pass must keep out of the serve tier: every producer and reader is
+    parked behind the flip instead of behind the staging rings."""
+
+    def __init__(self, router, handles):
+        self.router = router
+        self.handles = handles
+
+    def flip_epoch(self, new_router):
+        # blocking-call: a distributed barrier at the commit point turns
+        # the one atomic store into a fleet-wide stall
+        wait_at_barrier("resize-flip")
+        self.router = new_router
+
+    def _quiesce_snapshot(self, manager, target):
+        # blocking-call: a synchronous checkpoint inside the flip window
+        return manager.save_now(target)

@@ -35,7 +35,7 @@ BLOCK = 8
 class WorkerProc:
     """One ``python -m metrics_tpu.serve.worker`` child + its HTTP handle."""
 
-    def __init__(self, shard, checkpoint_root):
+    def __init__(self, shard, checkpoint_root, num_shards=NUM_SHARDS):
         self.shard = shard
         self.proc = subprocess.Popen(
             [
@@ -43,7 +43,7 @@ class WorkerProc:
                 "-m",
                 "metrics_tpu.serve.worker",
                 "--shard", str(shard),
-                "--num-shards", str(NUM_SHARDS),
+                "--num-shards", str(num_shards),
                 "--num-streams", str(S),
                 "--block-rows", str(BLOCK),
                 "--checkpoint-root", checkpoint_root,
@@ -74,31 +74,60 @@ class WorkerProc:
 class SubprocessFleet:
     """A coordinator over subprocess workers, with respawn-from-checkpoint."""
 
-    def __init__(self, checkpoint_root):
+    def __init__(self, checkpoint_root, num_shards=NUM_SHARDS):
         self.checkpoint_root = checkpoint_root
-        spec = FleetSpec(num_shards=NUM_SHARDS, jobs=drill_jobs(S))
+        spec = FleetSpec(num_shards=num_shards, jobs=drill_jobs(S))
         self.router = build_router(spec)
         self.workers = [
-            WorkerProc(shard, checkpoint_root) for shard in range(NUM_SHARDS)
+            WorkerProc(shard, checkpoint_root, num_shards=num_shards)
+            for shard in range(num_shards)
         ]
         self.coordinator = FleetCoordinator(
             self.router,
             [w.handle for w in self.workers],
             respawn=self._respawn,
+            provision=self._provision,
+            retire=self._retire,
             ring_capacity=4096,
         ).start()
 
     def _respawn(self, shard):
-        replacement = WorkerProc(shard, self.checkpoint_root)
+        # a replacement must agree with the LIVE epoch's span layout (the
+        # coordinator's router may be ahead of the construction-time one
+        # after a resize)
+        replacement = WorkerProc(
+            shard,
+            self.checkpoint_root,
+            num_shards=self.coordinator.router.num_shards,
+        )
         self.workers[shard] = replacement
         return replacement.handle
 
-    def feed(self, lo, hi):
+    def _provision(self, shard, router):
+        worker = WorkerProc(
+            shard, self.checkpoint_root, num_shards=router.num_shards
+        )
+        while len(self.workers) <= shard:
+            self.workers.append(None)
+        self.workers[shard] = worker
+        return worker.handle
+
+    def _retire(self, shard):
+        if shard < len(self.workers) and self.workers[shard] is not None:
+            self.workers[shard].terminate()
+            self.workers[shard] = None
+
+    def feed(self, lo, hi, dyadic=False):
         """Deterministic single-threaded feed: both runs see the same rows
         in the same order, so block boundaries (and float accumulation
-        order) match exactly."""
-        tenant = ColumnTraffic("per_tenant", arity=2, num_streams=S, seed=21)
-        plain = ColumnTraffic("mse", arity=2, seed=22)
+        order) match exactly.  ``dyadic`` quantizes values to multiples of
+        1/8 — required when the twin fleets shard DIFFERENTLY (a resize
+        drill), where block groupings diverge and only exact accumulation
+        can stay bitwise."""
+        tenant = ColumnTraffic(
+            "per_tenant", arity=2, num_streams=S, seed=21, dyadic=dyadic
+        )
+        plain = ColumnTraffic("mse", arity=2, seed=22, dyadic=dyadic)
         for start in range(lo, hi, 64):
             end = min(start + 64, hi)
             cols, ids = tenant.batch(start, end)
@@ -112,12 +141,17 @@ class SubprocessFleet:
 
     def checkpoint_all(self):
         # the workers' HTTP POST /flush + /checkpoint routes, end to end
-        return {w.shard: w.handle.checkpoint() for w in self.workers}
+        return {
+            w.shard: w.handle.checkpoint()
+            for w in self.workers
+            if w is not None
+        }
 
     def stop(self):
         self.coordinator.stop()
         for w in self.workers:
-            w.terminate()
+            if w is not None:
+                w.terminate()
 
 
 @pytest.mark.slow
@@ -186,5 +220,66 @@ def test_subprocess_fleet_kill9_failover_is_bitwise(tmp_path):
         frontend.shutdown()
         http_thread.join(timeout=5.0)
         frontend.server_close()
+        fleet.stop()
+        twin.stop()
+
+
+@pytest.mark.slow
+def test_subprocess_resize_storm_sigkill_is_bitwise(tmp_path):
+    """The elastic drill over REAL processes: grow 2→4, then shrink 4→3
+    with a SIGKILL mid-migration.  The killed resize aborts pre-flip, a
+    failover restores the victim from its quiesced checkpoint, the retry
+    lands, and ``compute_all`` stays bit-identical to a never-resized
+    3-shard twin fed the same rows."""
+    fleet = SubprocessFleet(str(tmp_path / "fleet"), num_shards=2)
+    twin = SubprocessFleet(str(tmp_path / "twin"), num_shards=3)
+    try:
+        for f in (fleet, twin):
+            f.feed(0, 400, dyadic=True)
+            assert f.coordinator.flush(60.0)
+
+        def durable(phase):
+            # the subprocess analogue of LocalFleet.resize's durability
+            # floor: snapshot every live worker once the fleet quiesces
+            if phase == "quiesced":
+                fleet.checkpoint_all()
+
+        summary = fleet.coordinator.resize(4, timeout=120.0, phase_hook=durable)
+        assert summary["new_shards"] == 4 and summary["epoch"] == 1
+        for f in (fleet, twin):
+            f.feed(400, 600, dyadic=True)
+            # settle before the storm: the kill must not race rows still
+            # being forwarded (a SIGKILL always loses a worker's queued-
+            # but-undispatched rows — the standing failover loss model;
+            # the drill's zero-loss claim is about MIGRATED state)
+            assert f.coordinator.flush(60.0)
+
+        victim = 3  # departs in 4→3, so it must donate its whole span
+
+        def storm(phase):
+            if phase == "quiesced":
+                fleet.checkpoint_all()
+                fleet.workers[victim].sigkill()
+
+        resize_failures = counter_value("serve.resize_failures")
+        with pytest.raises(Exception):
+            fleet.coordinator.resize(3, timeout=120.0, phase_hook=storm)
+        assert counter_value("serve.resize_failures") == resize_failures + 1
+        # pre-flip abort: still 4 shards on the old epoch, nothing held
+        stats = fleet.coordinator.ring_stats()
+        assert stats["num_shards"] == 4 and stats["epoch"] == 1
+        assert stats["held_jobs"] == []
+
+        fleet.coordinator.failover(victim)
+        summary = fleet.coordinator.resize(3, timeout=120.0, phase_hook=durable)
+        assert summary["new_shards"] == 3 and summary["epoch"] == 2
+
+        for f in (fleet, twin):
+            f.feed(600, 800, dyadic=True)
+            assert f.coordinator.flush(60.0)
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute_all(), twin.coordinator.compute_all()
+        )
+    finally:
         fleet.stop()
         twin.stop()

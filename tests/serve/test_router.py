@@ -4,7 +4,7 @@ import numpy as np
 import pytest
 
 from metrics_tpu.obs import counter_value
-from metrics_tpu.serve import HashRing, ShardRouter
+from metrics_tpu.serve import HashRing, ShardRouter, migration_plan
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 
@@ -139,3 +139,159 @@ class TestPartitionIds:
         lo, hi = router.span("tenants", 2)
         parts = router.partition_ids("tenants", np.arange(lo, hi))
         assert list(parts) == [2]
+
+
+class TestOwnerOfIds:
+    def test_matches_scalar_routing_including_oob(self):
+        router = ShardRouter(3, {"tenants": 11})
+        ids = np.array([-2, 0, 3, 4, 7, 10, 12], np.int64)
+        owners = router.owner_of_ids("tenants", ids)
+        for sid, owner in zip(ids, owners):
+            assert int(owner) == router.local_id("tenants", int(sid))[0]
+
+    def test_does_not_count_routes(self):
+        # the forwarder calls this on every drain pass; it must not inflate
+        # serve.shard_routes the way partition_ids (one call per batch) does
+        router = ShardRouter(2, {"tenants": 8})
+        before = sum(
+            counter_value("serve.shard_routes", shard=str(s)) for s in range(2)
+        )
+        router.owner_of_ids("tenants", np.arange(8))
+        after = sum(
+            counter_value("serve.shard_routes", shard=str(s)) for s in range(2)
+        )
+        assert after == before
+
+
+class TestMinimalMovement:
+    """Quantitative consistent-hashing guarantees of the blake2b ring."""
+
+    def test_grow_moves_keys_only_to_the_new_shard(self):
+        # the strong form of minimal movement: adding shard N may steal
+        # keys, but every stolen key lands ON shard N — no lateral churn
+        old = HashRing(range(6), vnodes=64)
+        new = HashRing(range(7), vnodes=64)
+        for i in range(400):
+            key = f"job-{i}"
+            if old.lookup(key) != new.lookup(key):
+                assert new.lookup(key) == 6
+
+    def test_shrink_moves_only_the_departing_shards_keys(self):
+        old = HashRing(range(7), vnodes=64)
+        new = HashRing(range(6), vnodes=64)
+        for i in range(400):
+            key = f"job-{i}"
+            if old.lookup(key) == 6:
+                assert new.lookup(key) != 6
+            else:
+                assert new.lookup(key) == old.lookup(key)
+
+    def test_grow_steals_roughly_its_fair_share(self):
+        # expectation is 1/(N+1) of keys; allow a generous 3x statistical
+        # margin so vnode variance cannot flake the suite
+        n, keys = 6, [f"job-{i}" for i in range(1200)]
+        old = HashRing(range(n), vnodes=64)
+        new = HashRing(range(n + 1), vnodes=64)
+        moved = sum(old.lookup(k) != new.lookup(k) for k in keys)
+        assert 0 < moved < 3 * len(keys) // (n + 1)
+
+
+class TestResizedAndMigrationPlan:
+    JOBS = {"mse": None, "acc": None, "f1": None, "tenants": 48, "loss": 96}
+
+    def test_resized_bumps_epoch_and_keeps_vnodes(self):
+        router = ShardRouter(3, self.JOBS, vnodes=32)
+        grown = router.resized(5)
+        assert router.epoch == 0 and grown.epoch == 1
+        assert grown.num_shards == 5
+        assert grown.resized(3).epoch == 2
+        # same ring geometry: a plain job that did not move hashes alike
+        rebuilt = ShardRouter(5, self.JOBS, vnodes=32)
+        for job in ("mse", "acc", "f1"):
+            assert grown.owner(job) == rebuilt.owner(job)
+
+    def test_plan_moves_exactly_the_changed_rows(self):
+        old = ShardRouter(3, self.JOBS)
+        new = old.resized(5)
+        plan = migration_plan(old, new)
+        assert plan.old_shards == 3 and plan.new_shards == 5
+        for job in ("tenants", "loss"):
+            total = old.num_streams(job)
+            moved = np.zeros(total, np.int32)
+            for move in plan.moves:
+                if move.job != job:
+                    continue
+                assert not move.plain and move.donor != move.recipient
+                o_lo, o_hi = old.span(job, move.donor)
+                n_lo, n_hi = new.span(job, move.recipient)
+                assert o_lo <= move.lo < move.hi <= o_hi
+                assert n_lo <= move.lo < move.hi <= n_hi
+                moved[move.lo : move.hi] += 1
+            for sid in range(total):
+                changed = (
+                    old.local_id(job, sid)[0] != new.local_id(job, sid)[0]
+                )
+                assert moved[sid] == int(changed)  # once if moved, else never
+        assert plan.rows() == int(
+            sum(
+                old.local_id(j, s)[0] != new.local_id(j, s)[0]
+                for j in ("tenants", "loss")
+                for s in range(old.num_streams(j))
+            )
+        )
+
+    def test_plan_plain_moves_track_ring_ownership(self):
+        old = ShardRouter(6, self.JOBS)
+        new = old.resized(7)
+        plan = migration_plan(old, new)
+        plain = {m.job: m for m in plan.moves if m.plain}
+        for job in ("mse", "acc", "f1"):
+            if old.owner(job) != new.owner(job):
+                move = plain[job]
+                assert move.donor == old.owner(job)
+                assert move.recipient == new.owner(job)
+            else:
+                assert job not in plain
+
+    def test_randomized_resize_sequence_invariants(self):
+        rng = np.random.default_rng(42)
+        router = ShardRouter(2, self.JOBS)
+        for step in range(12):
+            n = int(rng.integers(1, 9))
+            if n == router.num_shards:
+                n += 1
+            new = router.resized(n)
+            assert new.epoch == router.epoch + 1
+            plan = migration_plan(router, new)
+            for job in ("tenants", "loss"):
+                # new spans tile [0, S) contiguously after every resize
+                spans = [new.span(job, s) for s in range(n)]
+                assert spans[0][0] == 0
+                assert spans[-1][1] == router.num_streams(job)
+                for (_, hi), (lo, _) in zip(spans, spans[1:]):
+                    assert hi == lo
+                # every changed row moves exactly once, donor -> recipient
+                for sid in range(router.num_streams(job)):
+                    old_owner = router.local_id(job, sid)[0]
+                    new_owner = new.local_id(job, sid)[0]
+                    hits = [
+                        m
+                        for m in plan.moves
+                        if m.job == job and not m.plain and m.lo <= sid < m.hi
+                    ]
+                    if old_owner == new_owner:
+                        assert hits == []
+                    else:
+                        assert len(hits) == 1
+                        assert hits[0].donor == old_owner
+                        assert hits[0].recipient == new_owner
+            router = new
+
+    def test_plan_rejects_mismatched_routers(self):
+        old = ShardRouter(2, {"tenants": 8})
+        with pytest.raises(MetricsTPUUserError):
+            migration_plan(old, ShardRouter(3, {"other": 8}))
+        with pytest.raises(MetricsTPUUserError):
+            migration_plan(old, ShardRouter(3, {"tenants": 12}))
+        with pytest.raises(MetricsTPUUserError):
+            migration_plan(old, ShardRouter(3, {"tenants": None}))
