@@ -1583,6 +1583,170 @@ def _bench_fleet_resize(
         fleet.stop()
 
 
+def _bench_wal_ingest(
+    n_records=48_000,
+    block_rows=256,
+    num_streams=256,
+    batch_sweep=(256, 512, 1024),
+    replay_rows=1_000_000,
+    replay_frame_rows=8192,
+):
+    """Config 13: durable ingest — the write-ahead log's throughput tax.
+
+    The same 2-shard columnar ingest as config 11, measured twice per
+    group-commit batch size: once queue-ack (``wal_root=None``, the old
+    loss model) and once durable-ack (every batch framed, fsync'd, and
+    acked only after the group commit lands).  The sweep over batch rows
+    is the amortization story: one fsync covers one frame, so bigger
+    frames spread the disk barrier across more rows — the ratio must
+    clear 70% at the production batch size for durable-ack to be the
+    default anyone turns on.  ``replay`` prices recovery: a
+    ``replay_rows``-row log decoded end to end (magic + crc32 + column
+    reconstruction per frame), which bounds how fast failover can re-home
+    a dead shard's tail.  The timed windows run over warmed block shapes
+    and must close with ``timed_recompiles == 0`` — durability is I/O,
+    and it must not perturb the jit cache.
+    """
+    import shutil
+    import tempfile
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.obs import counters_snapshot, summarize_counters
+    from metrics_tpu.serve import (
+        ColumnTraffic,
+        FleetSpec,
+        JobSpec,
+        LocalFleet,
+        ServeConfig,
+        WalWriter,
+        replay_frames,
+        run_load,
+    )
+
+    def _timed_jits(before):
+        return sum(
+            int(v - before.get(k, 0))
+            for k, v in counters_snapshot().items()
+            if k[0] == "jit_traces"
+        )
+
+    counters_before = counters_snapshot()
+    recompiles = 0
+    scratch = tempfile.mkdtemp(prefix="bench_wal_")
+    profile = {
+        "records": n_records,
+        "block_rows": block_rows,
+        "num_streams": num_streams,
+    }
+    rates = {}  # (wal?, batch_rows) -> rps
+    try:
+        for wal_on in (False, True):
+            for batch_rows in batch_sweep:
+                tag = f"wal{'on' if wal_on else 'off'}_b{batch_rows}"
+                spec = FleetSpec(
+                    num_shards=2,
+                    jobs=[
+                        JobSpec("mse", MeanSquaredError, num_streams=None),
+                        JobSpec(
+                            "per_tenant",
+                            MeanSquaredError,
+                            num_streams=num_streams,
+                        ),
+                    ],
+                    server_config=ServeConfig(
+                        block_rows=block_rows,
+                        queue_capacity=65536,
+                        flush_interval=3600.0,
+                    ),
+                    ring_capacity=n_records,
+                    wal_root=os.path.join(scratch, tag) if wal_on else None,
+                )
+                fleet = LocalFleet(spec).start()
+                try:
+                    tenant_traffic = ColumnTraffic(
+                        "per_tenant", arity=2, num_streams=num_streams, seed=13
+                    )
+                    mse_traffic = ColumnTraffic("mse", arity=2, seed=14)
+
+                    def ingest(lo, hi):
+                        cols, sids = tenant_traffic.batch(lo, hi)
+                        a1, r1 = fleet.coordinator.ingest_columns(
+                            "per_tenant", cols, sids
+                        )
+                        cols2, _ = mse_traffic.batch(lo, hi)
+                        a2, r2 = fleet.coordinator.ingest_columns("mse", cols2)
+                        return a1 + a2, r1 + r2
+
+                    ingest(0, 4 * block_rows - 1)  # warm both shards' shapes
+                    if not fleet.coordinator.flush(60.0):
+                        raise RuntimeError(f"{tag}: warmup flush timed out")
+                    jit0 = counters_snapshot()
+                    runs = []
+                    for _ in range(3):
+                        report = run_load(
+                            ingest,
+                            total_records=n_records // 2,  # 2 records per slot
+                            batch_rows=batch_rows,
+                            threads=1,
+                            flush=lambda: fleet.coordinator.flush(120.0),
+                        )
+                        if report.rejected or report.errors:
+                            raise RuntimeError(
+                                f"{tag}: rejected {report.rejected} row(s): "
+                                f"{report.errors}"
+                            )
+                        runs.append(report.accepted / report.elapsed_s)
+                    recompiles += _timed_jits(jit0)
+                    rates[(wal_on, batch_rows)] = float(np.median(runs))
+                    profile[f"ingest_rps_{tag}"] = round(
+                        rates[(wal_on, batch_rows)], 1
+                    )
+                finally:
+                    fleet.stop()
+
+        for batch_rows in batch_sweep:
+            profile[f"wal_on_off_ratio_b{batch_rows}"] = round(
+                rates[(True, batch_rows)] / rates[(False, batch_rows)], 3
+            )
+        top = max(batch_sweep)
+        profile["wal_throughput_ratio"] = max(
+            profile[f"wal_on_off_ratio_b{b}"] for b in batch_sweep
+        )
+
+        # ---- replay: decode a dead shard's whole log, wall-clock
+        replay_dir = os.path.join(scratch, "replay")
+        rng = np.random.default_rng(13)
+        frame_cols = [
+            rng.uniform(size=replay_frame_rows).astype(np.float32)
+            for _ in range(2)
+        ]
+        frame_ids = rng.integers(0, num_streams, replay_frame_rows).astype(
+            np.int32
+        )
+        n_frames = max(1, replay_rows // replay_frame_rows)
+        with WalWriter(replay_dir) as writer:
+            for _ in range(n_frames):
+                writer.append("per_tenant", frame_cols, frame_ids)
+            last = writer.append("per_tenant", frame_cols, frame_ids)
+            if not last.wait(120.0):
+                raise RuntimeError("replay log build: group commit timed out")
+        t0 = time.perf_counter()
+        replayed = sum(f.rows for f in replay_frames(replay_dir))
+        replay_secs = time.perf_counter() - t0
+        profile["replay_rows"] = int(replayed)
+        profile["replay_wall_ms"] = round(replay_secs * 1e3, 1)
+        profile["replay_rows_per_sec"] = round(replayed / replay_secs, 1)
+
+        profile["timed_recompiles"] = recompiles
+        after = counters_snapshot()
+        profile["serve_counters"] = summarize_counters(
+            {k: v - counters_before.get(k, 0) for k, v in after.items()}
+        ).get("serve", {})
+        return rates[(True, top)], profile
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def _make_detection_batch_fixed(rng, batch_size, boxes_per_image=4):
     """Detection batch with a FIXED box count per image.
 
@@ -2074,6 +2238,7 @@ def main() -> None:
         ("config9_serve_ingest_records_per_sec", _bench_serve),
         ("config11_serve_fleet_ingest_records_per_sec", _bench_serve_fleet),
         ("config12_fleet_resize_grow_wall_ms", _bench_fleet_resize),
+        ("config13_wal_ingest_records_per_sec", _bench_wal_ingest),
         ("config10_mesh_ddp_samples_per_sec", _bench_mesh_ddp),
         ("device_mfu", _bench_mfu),
     ):
@@ -2219,6 +2384,25 @@ def main() -> None:
                     "timed_recompiles",
                 ):
                     extra[f"config12_fleet_resize_{key}"] = result[1][key]
+            elif name.startswith("config13_wal_ingest"):
+                extra[name] = round(result[0], 1)
+                extra["config13_wal_ingest_profile"] = result[1]
+                # lift to scalars so the compact line (which drops nested
+                # dicts) carries the durability tax per batch size, the
+                # replay wall-clock, and the zero-recompile proof
+                for key, val in (result[1].get("serve_counters") or {}).items():
+                    extra[f"config13_wal_ingest_{key}"] = val
+                for key in (
+                    "wal_throughput_ratio",
+                    "replay_rows",
+                    "replay_wall_ms",
+                    "replay_rows_per_sec",
+                    "timed_recompiles",
+                ):
+                    extra[f"config13_wal_ingest_{key}"] = result[1][key]
+                for key, val in result[1].items():
+                    if key.startswith(("ingest_rps_wal", "wal_on_off_ratio_b")):
+                        extra[f"config13_wal_ingest_{key}"] = val
             elif name.startswith("config9_serve"):
                 extra[name] = round(result[0], 1)
                 extra["config9_serve_profile"] = result[1]

@@ -258,6 +258,10 @@ class RestoreResult:
     reset_metrics: List[str] = field(default_factory=list)
     missing_shards: List[int] = field(default_factory=list)
     stale_steps: List[int] = field(default_factory=list)  # uncommitted/corrupt steps skipped
+    # opaque caller state saved alongside this rank's primary shard (e.g. the
+    # serve tier's WAL applied-seq watermarks); None when the checkpoint
+    # carried none or the primary shard's metadata was unreadable
+    extra: Optional[Dict[str, Any]] = None
 
 
 class CheckpointManager:
@@ -374,6 +378,7 @@ class CheckpointManager:
         target: Target,
         step: Optional[int] = None,
         encoded: Optional[EncodedTarget] = None,
+        extra: Optional[Dict[str, Any]] = None,
     ) -> int:
         """Commit one checkpoint of ``target``; returns the step committed.
 
@@ -384,6 +389,13 @@ class CheckpointManager:
 
         Pass ``encoded`` (from :meth:`encode_target`) to commit blobs that
         were serialized earlier — the non-blocking snapshot path.
+
+        ``extra`` is an opaque JSON-serializable dict committed atomically
+        with this rank's shard (it rides the shard metadata, inside the
+        manifest commit); :meth:`restore` hands it back via
+        ``RestoreResult.extra``.  The serve tier stores its WAL applied-seq
+        watermarks here so "state" and "how far the log is folded in" can
+        never commit separately.
         """
         if step is None:
             latest = self.latest_step()
@@ -395,6 +407,9 @@ class CheckpointManager:
             if encoded is None:
                 encoded = self.encode_target(target)
             shard_meta = encoded.shard_meta
+            if extra is not None:
+                shard_meta = dict(shard_meta)
+                shard_meta["extra"] = extra
             manifest_schema = encoded.manifest_schema
             import numpy as np
 
@@ -468,10 +483,11 @@ class CheckpointManager:
         target: Target,
         step: Optional[int] = None,
         encoded: Optional[EncodedTarget] = None,
+        extra: Optional[Dict[str, Any]] = None,
     ) -> int:
         """Unconditional checkpoint: commit, disarm any pending
         :meth:`request_save`, and reset the staleness clock."""
-        committed = self.save(target, step=step, encoded=encoded)
+        committed = self.save(target, step=step, encoded=encoded, extra=extra)
         self._save_requested.clear()
         return committed
 
@@ -658,6 +674,12 @@ class CheckpointManager:
         sdir = _step_dir(result.step)
         ckpt_world = result.world_size
         my_shards = [s for s in range(ckpt_world) if s % self.world_size == self.rank]
+        if my_shards:
+            # surface the primary shard's opaque caller state (WAL
+            # watermarks etc.) exactly as it was committed with the shard
+            primary_meta = manifest["shards"].get(str(my_shards[0]), {})
+            if isinstance(primary_meta, dict):
+                result.extra = primary_meta.get("extra")
         manifest_keys = sorted(manifest["metrics"])
         _prepare_target_structure(target, manifest_keys)
         metrics = flatten_target(target)

@@ -31,6 +31,12 @@ in pre-allocated columnar rings and forwards per shard, scatter-gathers
 and fails a dead shard over to a replacement restored from its own
 checkpoint directory.  See ``docs/serving.md``.
 
+Need the ack to survive a SIGKILL?  Set ``FleetSpec.wal_root``: every
+accepted batch is framed into a per-shard write-ahead log
+(:class:`WalWriter`, group-commit fsync) before the ``200``, checkpoints
+carry applied-seq watermarks, and failover replays the log with seq
+dedup — exactly-once, no client resends.  See the loss-model table in
+``docs/serving.md``.
 """
 
 from metrics_tpu.serve.autoscaler import (
@@ -71,6 +77,14 @@ from metrics_tpu.serve.router import (
 )
 from metrics_tpu.serve.server import EvalServer, ServeConfig
 from metrics_tpu.serve.traffic import JobTraffic, TrafficGenerator, default_traffic
+from metrics_tpu.serve.wal import (
+    WalCorruption,
+    WalFrame,
+    WalTicket,
+    WalWriter,
+    inject_wal_fault,
+    replay_frames,
+)
 
 __all__ = [
     "Autoscaler",
@@ -101,11 +115,17 @@ __all__ = [
     "ShardRouter",
     "SpanMove",
     "TrafficGenerator",
+    "WalCorruption",
+    "WalFrame",
+    "WalTicket",
+    "WalWriter",
     "autoscale_step",
     "build_shard_registry",
     "default_traffic",
+    "inject_wal_fault",
     "make_fleet_http_server",
     "migration_plan",
+    "replay_frames",
     "run_load",
     "run_process_load",
 ]

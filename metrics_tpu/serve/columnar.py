@@ -25,7 +25,8 @@ growing without bound.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -75,6 +76,10 @@ class ColumnRing:
         self._count = 0  # buffered rows, pending included
         self._pending = 0  # rows handed to the drainer, not yet committed
         self._hwm = 0  # deepest the ring has ever been (autoscaler signal)
+        # WAL frame spans: [seq_or_None, rows] per buffered put batch, in
+        # ring order, summing to _count.  Allocated lazily on the first
+        # framed put so non-WAL rings pay nothing.
+        self._spans: Optional[Deque[List[object]]] = None
 
     @property
     def arity(self) -> int:
@@ -99,9 +104,20 @@ class ColumnRing:
         self,
         cols: Sequence[np.ndarray],
         stream_ids: Optional[np.ndarray] = None,
+        frame: Optional[
+            Callable[[List[np.ndarray], Optional[np.ndarray]], int]
+        ] = None,
     ) -> bool:
         """Copy one batch into the ring; ``False`` (counted) when it does
-        not fit — backpressure, not blocking."""
+        not fit — backpressure, not blocking.
+
+        ``frame``, when given, is called *under the ring mutex, only after
+        the batch is guaranteed accepted*, with the dtype-converted columns
+        and ids; it must return the batch's WAL sequence number.  Running
+        the WAL append inside the critical section pins ring order == seq
+        order (the exactly-once replay invariant) without a second lock,
+        and a rejected batch never consumes a seq or touches the log.
+        """
         if len(cols) != len(self._cols):
             raise MetricsTPUUserError(
                 f"ring holds {len(self._cols)} column(s), got {len(cols)}"
@@ -148,6 +164,16 @@ class ColumnRing:
                 self._ids[head : head + split] = ids[:split]
                 if split < n:
                     self._ids[: n - split] = ids[split:]
+            if frame is not None:
+                if self._spans is None:
+                    self._spans = deque()
+                    if self._count:
+                        # rows staged before WAL mode engaged: unframed
+                        self._spans.append([None, self._count])
+                seq = int(frame(arrs, ids))
+                self._spans.append([seq, n])
+            elif self._spans is not None:
+                self._spans.append([None, n])
             self._count += n
             if self._count > self._hwm:
                 # counter carries the delta so the summed counter IS the
@@ -193,6 +219,90 @@ class ColumnRing:
             self._pending = run
             return views, id_view, run
 
+    def drain_frames(
+        self, timeout: float, max_rows: Optional[int] = None
+    ) -> Optional[
+        Tuple[
+            List[np.ndarray],
+            Optional[np.ndarray],
+            int,
+            List[Tuple[Optional[int], int]],
+        ]
+    ]:
+        """:meth:`drain`, but clipped to whole WAL frames.
+
+        Returns ``(col_views, id_view_or_None, n, spans)`` where ``spans``
+        is ``[(seq_or_None, rows), ...]`` partitioning the ``n`` rows into
+        the put batches they arrived as — the forwarder ships them so the
+        worker can seq-dedup per frame.  On a ring with no framed puts this
+        degrades to plain :meth:`drain` with one anonymous span.
+
+        The run never ends mid-frame: shipping half a frame under a seq
+        would let a retry double-apply the other half.  When the frame at
+        the front straddles the ring's wrap point (so no contiguous view
+        can cover it), that one frame is returned as a **copy** — the only
+        allocation on this path, bounded to one frame per ring cycle.
+        """
+        if self._pending:
+            raise MetricsTPUUserError(
+                "previous drain not committed; call commit(n) first"
+            )
+        with self._readable:
+            if self._count == 0:
+                self._readable.wait(timeout)
+            avail = self._count
+            if avail == 0:
+                return None
+            run = min(avail, self.capacity - self._tail)
+            if max_rows is not None:
+                run = min(run, int(max_rows))
+            if self._spans is None:
+                views = [c[self._tail : self._tail + run] for c in self._cols]
+                id_view = (
+                    None
+                    if self._ids is None
+                    else self._ids[self._tail : self._tail + run]
+                )
+                self._pending = run
+                return views, id_view, run, [(None, run)]
+            covered, spans = self._span_prefix(run)
+            if covered:
+                views = [c[self._tail : self._tail + covered] for c in self._cols]
+                id_view = (
+                    None
+                    if self._ids is None
+                    else self._ids[self._tail : self._tail + covered]
+                )
+                self._pending = covered
+                return views, id_view, covered, spans
+            # the front frame is wider than the contiguous window (wrap) or
+            # the max_rows clip: hand out exactly that frame, copying the
+            # two arcs together when it wraps
+            seq, rows = self._spans[0]
+            rows = int(rows)
+            views = [self._arc(c, rows) for c in self._cols]
+            id_view = None if self._ids is None else self._arc(self._ids, rows)
+            self._pending = rows
+            return views, id_view, rows, [(seq, rows)]  # type: ignore[list-item]
+
+    def _arc(self, col: np.ndarray, rows: int) -> np.ndarray:
+        first = min(rows, self.capacity - self._tail)
+        if first >= rows:
+            return col[self._tail : self._tail + rows]
+        return np.concatenate([col[self._tail :], col[: rows - first]])
+
+    def _span_prefix(
+        self, limit: int
+    ) -> Tuple[int, List[Tuple[Optional[int], int]]]:
+        covered = 0
+        out: List[Tuple[Optional[int], int]] = []
+        for seq, rows in self._spans:  # type: ignore[union-attr]
+            if covered + int(rows) > limit:  # type: ignore[arg-type]
+                break
+            out.append((seq, int(rows)))  # type: ignore[arg-type]
+            covered += int(rows)  # type: ignore[arg-type]
+        return covered, out
+
     def commit(self, n: int) -> None:
         """Release the first ``n`` rows of the outstanding drain: their
         slots become writable and the views returned for them go stale."""
@@ -208,6 +318,24 @@ class ColumnRing:
                 # will be re-drained later (forward failure, held job,
                 # split-owner prefix) — commit(0) parks the whole drain
                 _obs.counter_inc("serve.parked_rows", self._pending - n)
+            if self._spans is not None and n:
+                remaining = n
+                while remaining and self._spans:
+                    span = self._spans[0]
+                    rows = int(span[1])  # type: ignore[arg-type]
+                    if rows <= remaining:
+                        remaining -= rows
+                        self._spans.popleft()
+                        continue
+                    # a commit inside a frame (split-owner prefix, mid-
+                    # resize): the surviving remainder can no longer replay
+                    # exactly-once as a frame, so it is demoted to unframed
+                    # rows — the documented resize/WAL overlap caveat
+                    span[1] = rows - remaining
+                    if span[0] is not None:
+                        span[0] = None
+                        _obs.counter_inc("serve.wal_unframed_rows", rows - remaining)
+                    remaining = 0
             self._tail = (self._tail + n) % self.capacity
             self._count -= n
             self._pending = 0

@@ -22,15 +22,28 @@ per shard and does three things:
   unreachable worker marks the fleet degraded; the HTTP ``/healthz``
   answers 503) and ``failover(shard)`` swaps in a replacement handle from
   the injected ``respawn`` callback (the fleet layer restores the dead
-  shard's checkpoint before handing the handle back).
+  shard's checkpoint before handing the handle back).  With a WAL
+  attached, failover replays the dead shard's log past the replacement's
+  checkpoint watermarks *before* installing the handle, so the recovered
+  worker answers with every durably-acked row and zero client resends.
+* **Durable ingest (optional)** — when constructed with per-shard
+  :class:`~metrics_tpu.serve.wal.WalWriter` instances, every accepted
+  batch is framed into the target shard's WAL *under the ring mutex* (so
+  ring order == seq order) and the ingest ack waits for the frame's
+  group-commit fsync.  Forwarders then ship whole frames tagged with
+  their seqs; workers dedup on seq, which is what makes both forward
+  retries and failover replay exactly-once.
 
 Shard handles are **duck-typed** on purpose: the coordinator never
 imports or constructs worker machinery, so ``tools/analyze``'s
 serve-blocking and lock-order passes check this whole module with no
-opt-outs — nothing on a request thread may block, and nothing here does.
-A handle provides::
+opt-outs — nothing on a request thread may block.  The single sanctioned
+wait is the WAL durability latch (``WalTicket.wait``): a durable ack
+*means* "wait for fsync", and the wait parks on an event the dedicated
+writer thread sets after one group commit — the request thread never
+touches the disk itself.  A handle provides::
 
-    ingest_columns(job, cols, stream_ids=None) -> bool
+    ingest_columns(job, cols, stream_ids=None, seqs=None) -> bool
     ingest_rows(job, rows)                     -> (accepted, rejected)
     compute(job)                               -> jsonable
     compute_streams(job, local_ids)            -> jsonable list
@@ -72,6 +85,7 @@ from metrics_tpu.obs.exporters import prometheus_text
 from metrics_tpu.serve.columnar import ColumnRing
 from metrics_tpu.serve.httpd import _MAX_INGEST_BYTES, PooledHTTPServer
 from metrics_tpu.serve.router import migration_plan
+from metrics_tpu.serve.wal import replay_frames
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 __all__ = [
@@ -84,6 +98,8 @@ __all__ = [
 _FORWARD_POLL_S = 0.005  # forwarder idle poll (timed waits only)
 _FORWARD_IDLE_MAX_S = 0.08  # idle backoff cap: keeps N sleeping forwarders
 # from preempting request threads every few ms on small hosts
+_WAL_ACK_TIMEOUT_S = 10.0  # durable-ack bound: a wedged disk surfaces as an
+# ingest error instead of a hung request thread
 
 
 def _is_scalar(value: Any) -> bool:
@@ -112,6 +128,7 @@ class HTTPShard:
         self.timeout = float(timeout)
         self.retries = max(0, int(retries))
         self.retry_backoff = float(retry_backoff)
+        self.last_checkpoint_wal_marks: Optional[Dict[str, int]] = None
 
     # ------------------------------------------------------------- plumbing
     def _get(self, path: str) -> Dict[str, Any]:
@@ -137,23 +154,46 @@ class HTTPShard:
                 _obs.counter_inc("serve.shard_retries")
                 time.sleep(self.retry_backoff * attempt)
 
-    def _post(self, path: str, body: bytes, content_type: str) -> Tuple[int, Dict[str, Any]]:
-        req = Request(
-            self.base + path,
-            data=body,
-            headers={"Content-Type": content_type},
-            method="POST",
-        )
-        try:
-            with urlopen(req, timeout=self.timeout) as resp:
-                return resp.status, json.loads(resp.read().decode())
-        except HTTPError as err:
-            raw = err.read()
+    def _post(
+        self,
+        path: str,
+        body: bytes,
+        content_type: str,
+        idempotent: bool = False,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """POST; connection failures retry only when ``idempotent``.
+
+        A blind POST retry could double-apply rows (the worker may have
+        committed the first attempt before the connection dropped), so by
+        default connection errors surface at once.  WAL-framed ingest
+        carries per-frame sequence numbers the worker dedups on, which is
+        exactly the idempotency key a safe retry needs — those calls opt
+        in and get the same bounded linear-backoff retry as ``_get``.
+        """
+        attempt = 0
+        while True:
+            req = Request(
+                self.base + path,
+                data=body,
+                headers={"Content-Type": content_type},
+                method="POST",
+            )
             try:
-                payload = json.loads(raw.decode()) if raw else {}
-            except ValueError:
-                payload = {}
-            return err.code, payload
+                with urlopen(req, timeout=self.timeout) as resp:
+                    return resp.status, json.loads(resp.read().decode())
+            except HTTPError as err:
+                raw = err.read()
+                try:
+                    payload = json.loads(raw.decode()) if raw else {}
+                except ValueError:
+                    payload = {}
+                return err.code, payload
+            except (URLError, OSError):
+                if not idempotent or attempt >= self.retries:
+                    raise
+                attempt += 1
+                _obs.counter_inc("serve.shard_retries")
+                time.sleep(self.retry_backoff * attempt)
 
     # --------------------------------------------------------------- ingest
     def ingest_columns(
@@ -161,21 +201,37 @@ class HTTPShard:
         job: str,
         cols: Sequence[np.ndarray],
         stream_ids: Optional[np.ndarray] = None,
+        seqs: Optional[Sequence[Tuple[Optional[int], int]]] = None,
     ) -> bool:
+        """Ship one columnar batch; ``seqs`` carries WAL frame spans.
+
+        ``seqs=[(seq_or_None, rows), ...]`` partitions the batch into the
+        WAL frames it arrived as; the worker dedups framed spans on seq,
+        so a seq-tagged POST is idempotent and connection failures earn a
+        retry (a duplicate delivery lands exactly once).  Untagged ships
+        keep the original never-blind-retry contract.
+        """
         cols = [np.ascontiguousarray(c) for c in cols]
-        header = {
+        header: Dict[str, Any] = {
             "job": job,
             "rows": int(cols[0].shape[0]),
             "arity": len(cols),
             "dtype": cols[0].dtype.str,
             "ids": stream_ids is not None,
         }
+        if seqs is not None:
+            header["seqs"] = [
+                [None if s is None else int(s), int(r)] for s, r in seqs
+            ]
         parts = [json.dumps(header).encode(), b"\n"]
         parts.extend(c.tobytes() for c in cols)
         if stream_ids is not None:
             parts.append(np.ascontiguousarray(stream_ids, dtype="<i4").tobytes())
         status, _ = self._post(
-            "/ingest_columns", b"".join(parts), "application/octet-stream"
+            "/ingest_columns",
+            b"".join(parts),
+            "application/octet-stream",
+            idempotent=seqs is not None,
         )
         return status == 200
 
@@ -248,6 +304,14 @@ class HTTPShard:
             raise MetricsTPUUserError(
                 f"shard {self.base} checkpoint failed: HTTP {status} {payload}"
             )
+        marks = payload.get("wal_marks")
+        # stash the committed watermarks so the owner (fleet layer or a
+        # soak driver holding the WalWriter) can truncate covered segments
+        self.last_checkpoint_wal_marks = (
+            {str(j): int(s) for j, s in marks.items()}
+            if isinstance(marks, dict)
+            else None
+        )
         return int(payload["step"])
 
     # ------------------------------------------------------ elastic resize
@@ -314,6 +378,10 @@ class FleetCoordinator:
         ingest_dtype: dtype scalar JSON records are staged at (the
             columnar hot path; float32 halves the wire for serving).
         query_timeout: per-shard bound on every scatter-gather wait.
+        wal: optional ``{shard: WalWriter}`` map; shards with a writer get
+            durable-ack ingest (frames fsync'd before the ack) and
+            exactly-once failover replay.  Shards absent from the map keep
+            queue-ack semantics.
     """
 
     def __init__(
@@ -326,6 +394,7 @@ class FleetCoordinator:
         ring_capacity: int = 8192,
         ingest_dtype: Any = np.float32,
         query_timeout: float = 30.0,
+        wal: Optional[Dict[int, Any]] = None,
     ) -> None:
         if len(handles) != router.num_shards:
             raise MetricsTPUUserError(
@@ -340,6 +409,7 @@ class FleetCoordinator:
         self.ring_capacity = int(ring_capacity)
         self.ingest_dtype = np.dtype(ingest_dtype)
         self.query_timeout = float(query_timeout)
+        self._wal: Dict[int, Any] = dict(wal) if wal else {}
         self._rings: Dict[Tuple[int, str], ColumnRing] = {}
         self._rings_lock = threading.Lock()
         try:  # named in the runtime lock-witness graph
@@ -423,6 +493,52 @@ class FleetCoordinator:
         # ring missed this pass is drained on the next poll
         return [(job, r) for (s, job), r in list(self._rings.items()) if s == shard]
 
+    def _framed_put(
+        self,
+        shard: int,
+        ring: ColumnRing,
+        job: str,
+        cols: List[np.ndarray],
+        ids: Optional[np.ndarray],
+        tickets: Dict[int, Any],
+    ) -> bool:
+        """``ring.put`` that frames the batch into the shard's WAL.
+
+        The frame callable runs *under the ring mutex, after acceptance*,
+        with the dtype-converted buffers the ring actually staged — so the
+        WAL records exactly the bytes that will ship, a rejected put never
+        consumes a seq, and ring order always equals seq order.  Only the
+        *last* ticket per shard is kept: the writer thread commits groups
+        in order, so its durability implies every earlier frame's.
+        """
+        writer = self._wal.get(shard)
+        if writer is None:
+            return ring.put(cols, ids)
+
+        def _frame(arrs: List[np.ndarray], fids: Optional[np.ndarray]) -> int:
+            ticket = writer.append(job, arrs, fids)
+            tickets[shard] = ticket
+            return ticket.seq
+
+        return ring.put(cols, ids, frame=_frame)
+
+    def _await_durable(self, tickets: Dict[int, Any]) -> None:
+        """Block until every staged frame's group commit lands.
+
+        This is the durable-ack barrier: the rows are already staged (they
+        WILL reach a worker), so a failed or timed-out fsync cannot be
+        reported as a rejection — it surfaces as an error the client sees
+        instead of a 200, and the worker-side seq dedup absorbs the
+        duplicate if the client then re-sends rows that did land.
+        """
+        for shard, ticket in tickets.items():
+            if not ticket.wait(_WAL_ACK_TIMEOUT_S):
+                _obs.counter_inc("serve.wal_ack_failures", shard=str(shard))
+                raise MetricsTPUUserError(
+                    f"WAL group commit failed for shard {shard}: rows are "
+                    "staged but not durable"
+                )
+
     def ingest_columns(
         self,
         job: str,
@@ -434,11 +550,15 @@ class FleetCoordinator:
         Returns ``(accepted, rejected)`` row counts; a rejection means the
         target shard's ring was full (its worker is slow or dead) — the
         caller sees backpressure immediately instead of queueing unbounded.
+        With a WAL attached, the return waits for every accepted frame's
+        group-commit fsync: a ``200`` built from this count is a durable
+        ack, not a queue ack.
         """
         cols = [np.asarray(c).reshape(-1) for c in cols]
         n = int(cols[0].shape[0]) if cols else 0
         if n == 0:
             return 0, 0
+        tickets: Dict[int, Any] = {}
         if self.router.is_multistream(job):
             if stream_ids is None:
                 raise MetricsTPUUserError(
@@ -454,15 +574,24 @@ class FleetCoordinator:
                 # affinity hint, and the forwarder re-resolves each row's
                 # owner at ship time — so rows parked across an elastic
                 # resize drain to the post-flip owner automatically
-                ok = ring.put([c[positions] for c in cols], ids64[positions])
+                ok = self._framed_put(
+                    shard,
+                    ring,
+                    job,
+                    [c[positions] for c in cols],
+                    ids64[positions],
+                    tickets,
+                )
                 if ok:
                     accepted += int(positions.shape[0])
                 else:
                     rejected += int(positions.shape[0])
+            self._await_durable(tickets)
             return accepted, rejected
         shard = self.router.owner(job)
         ring = self._ring(shard, job, len(cols), with_ids=False)
-        ok = ring.put(cols, None)
+        ok = self._framed_put(shard, ring, job, list(cols), None, tickets)
+        self._await_durable(tickets)
         return (n, 0) if ok else (0, n)
 
     def ingest_records(
@@ -569,13 +698,21 @@ class FleetCoordinator:
             errored = False
             router = self.router
             held = self._held_jobs
+            use_wal = bool(self._wal)
             for job, ring in self._shard_rings(shard):
                 if job in held:
                     continue
-                got = ring.drain(timeout=0.0)
+                if use_wal:
+                    got = ring.drain_frames(timeout=0.0)
+                else:
+                    got = ring.drain(timeout=0.0)
                 if got is None:
                     continue
-                views, id_view, n = got
+                if use_wal:
+                    views, id_view, n, spans = got
+                else:
+                    views, id_view, n = got
+                    spans = None
                 try:
                     if id_view is not None:
                         owners = router.owner_of_ids(job, id_view)
@@ -584,17 +721,60 @@ class FleetCoordinator:
                         mixed = owners != owners[0]
                         p = int(np.argmax(mixed)) if bool(mixed.any()) else n
                         target = int(owners[0])
+                    else:
+                        p, target = n, router.owner(job)
+                    ship_spans: Optional[List[Tuple[Optional[int], int]]]
+                    demoted = 0
+                    if spans is None:
+                        ship_spans = None
+                    elif p >= n:
+                        ship_spans = list(spans)
+                    else:
+                        # clip the owner prefix DOWN to a frame boundary:
+                        # half a frame under a seq would let a retry or a
+                        # replay double-apply the other half
+                        boundary = 0
+                        clipped: List[Tuple[Optional[int], int]] = []
+                        for seq, rows in spans:
+                            if boundary + int(rows) > p:
+                                break
+                            clipped.append((seq, int(rows)))
+                            boundary += int(rows)
+                        if boundary:
+                            p = boundary
+                            ship_spans = clipped
+                        else:
+                            # the owner split lands INSIDE the front frame
+                            # (a resize moved part of a framed span): ship
+                            # the prefix unframed; commit() demotes the
+                            # remainder — the documented resize/WAL caveat
+                            ship_spans = [(None, p)]
+                            demoted = p
+                    if ship_spans is not None and all(
+                        s is None for s, _r in ship_spans
+                    ):
+                        ship_spans = None  # nothing framed: plain wire
+                    if id_view is not None:
                         lo = router.span(job, target)[0]
                         ship_ids = (
                             id_view[:p].astype(np.int64) - lo
                         ).astype(np.int32)
                         ship_views = [v[:p] for v in views]
                     else:
-                        p, target = n, router.owner(job)
-                        ship_ids, ship_views = None, views
-                    ok = self._handles[target].ingest_columns(
-                        job, ship_views, ship_ids
-                    )
+                        ship_ids = None
+                        ship_views = (
+                            views if p >= n else [v[:p] for v in views]
+                        )
+                    if ship_spans is not None:
+                        ok = self._handles[target].ingest_columns(
+                            job, ship_views, ship_ids, seqs=ship_spans
+                        )
+                    else:
+                        ok = self._handles[target].ingest_columns(
+                            job, ship_views, ship_ids
+                        )
+                    if ok and demoted:
+                        _obs.counter_inc("serve.wal_unframed_rows", demoted)
                 except (OSError, URLError, IndexError):
                     # IndexError: the router moved under us (shrink); the
                     # rows park and re-route against the new epoch
@@ -872,6 +1052,16 @@ class FleetCoordinator:
         The callback restores the shard's latest checkpoint into a fresh
         worker and returns its handle; rows parked in the shard's staging
         rings then drain to the replacement automatically.
+
+        With a WAL attached, the shard's log is replayed into the
+        replacement *before* the handle goes live: every frame past the
+        restored checkpoint's applied-seq watermarks ships seq-tagged (the
+        worker floor dedups any frame the checkpoint already covered), so
+        the recovered worker answers queries with every durably-acked row
+        — no client resend, bitwise the same state as a worker that never
+        died.  Rows that drained to a *different* owner before the crash
+        (possible only after a resize re-homed part of this shard's span)
+        are skipped: that owner still holds them.
         """
         if self._respawn is None:
             raise MetricsTPUUserError(
@@ -884,9 +1074,66 @@ class FleetCoordinator:
                 f"shard must be in [0, {self.num_shards}), got {shard}"
             )
         replacement = self._respawn(shard)
+        writer = self._wal.get(shard)
+        if writer is not None:
+            self._replay_wal(shard, writer, replacement)
         self._handles[shard] = replacement
         _obs.counter_inc("serve.failovers", shard=str(shard))
         return replacement
+
+    def _replay_wal(self, shard: int, writer: Any, replacement: Any) -> None:
+        """Push a shard's WAL past the replacement's watermarks into it."""
+        try:
+            info = replacement.health()
+            raw = info.get("wal_marks") or {}
+        except Exception:  # noqa: BLE001 — a probe failure just means replay-all
+            raw = {}
+        marks = {str(j): int(s) for j, s in raw.items()}
+        router = self.router
+        deadline = time.monotonic() + self.query_timeout
+        replayed = 0
+        for frame in replay_frames(
+            writer.directory, watermarks=marks, on_error="skip_segment"
+        ):
+            if frame.stream_ids is not None:
+                owners = router.owner_of_ids(
+                    frame.job, frame.stream_ids.astype(np.int64)
+                )
+                mask = owners == shard
+                if not bool(mask.any()):
+                    continue  # re-homed by a resize: the new owner has them
+                lo = router.span(frame.job, shard)[0]
+                ship_ids = (
+                    frame.stream_ids[mask].astype(np.int64) - lo
+                ).astype(np.int32)
+                ship_cols = [np.ascontiguousarray(c[mask]) for c in frame.cols]
+            else:
+                if router.owner(frame.job) != shard:
+                    continue
+                ship_ids = None
+                ship_cols = [np.ascontiguousarray(c) for c in frame.cols]
+            rows = int(ship_cols[0].shape[0])
+            while True:
+                ok = False
+                try:
+                    ok = replacement.ingest_columns(
+                        frame.job, ship_cols, ship_ids, seqs=[(frame.seq, rows)]
+                    )
+                except (OSError, URLError):
+                    ok = False
+                if ok:
+                    replayed += rows
+                    break
+                if time.monotonic() >= deadline:
+                    raise MetricsTPUUserError(
+                        f"WAL replay to shard {shard} replacement stalled "
+                        f"at seq {frame.seq}"
+                    )
+                time.sleep(_FORWARD_POLL_S)  # replacement backpressure
+        if replayed:
+            _obs.counter_inc(
+                "serve.wal_replayed_rows", replayed, shard=str(shard)
+            )
 
     # ---------------------------------------------------------------- elastic
     def ring_stats(self) -> Dict[str, Any]:

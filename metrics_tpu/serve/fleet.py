@@ -33,6 +33,7 @@ run is bitwise identical to an uninterrupted one.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -48,6 +49,7 @@ from metrics_tpu.serve.coordinator import FleetCoordinator
 from metrics_tpu.serve.registry import MetricRegistry, _to_jsonable
 from metrics_tpu.serve.router import ShardRouter
 from metrics_tpu.serve.server import EvalServer, ServeConfig
+from metrics_tpu.serve.wal import WalWriter
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 __all__ = [
@@ -79,7 +81,17 @@ class JobSpec:
 
 @dataclass
 class FleetSpec:
-    """Everything needed to stand a fleet up (or respawn one shard of it)."""
+    """Everything needed to stand a fleet up (or respawn one shard of it).
+
+    ``wal_root`` switches ingest from queue-ack to durable-ack: each shard
+    gets a segmented :class:`~metrics_tpu.serve.wal.WalWriter` under
+    ``wal_root/shard_NNNN``, workers run with ``wal_exactly_once`` (seq
+    dedup + applied-seq watermarks in their checkpoints), and failover
+    replays the log — see ``docs/serving.md``'s loss-model table.
+    ``wal_fsync=False`` keeps the frames (page cache durability, enough
+    for SIGKILL drills) but skips the disk barrier; the bench sweep uses
+    it to price the fsync itself.
+    """
 
     num_shards: int
     jobs: Sequence[JobSpec]
@@ -90,6 +102,9 @@ class FleetSpec:
     ingest_dtype: Any = np.float32
     max_staleness: Optional[float] = None  # arms per-shard durability loops
     query_timeout: float = 30.0
+    wal_root: Optional[str] = None
+    wal_segment_bytes: int = 4 << 20
+    wal_fsync: bool = True
 
 
 def build_router(spec: FleetSpec) -> ShardRouter:
@@ -145,6 +160,7 @@ class InProcessShard:
 
     def __init__(self, server: EvalServer) -> None:
         self.server = server
+        self.last_checkpoint_wal_marks: Optional[Dict[str, int]] = None
 
     # --------------------------------------------------------------- ingest
     def ingest_columns(
@@ -152,12 +168,13 @@ class InProcessShard:
         job: str,
         cols: Sequence[np.ndarray],
         stream_ids: Optional[np.ndarray] = None,
+        seqs: Optional[Sequence[Tuple[Optional[int], int]]] = None,
     ) -> bool:
         # the coordinator's ring views go stale at commit(): copy at the
         # enqueue boundary (the HTTP handle serializes instead)
         owned = tuple(np.array(c, copy=True) for c in cols)
         ids = None if stream_ids is None else np.array(stream_ids, copy=True)
-        return self.server.submit_columns(job, owned, stream_ids=ids)
+        return self.server.submit_columns(job, owned, stream_ids=ids, seqs=seqs)
 
     def ingest_rows(
         self, job: str, rows: Sequence[Tuple[Tuple[Any, ...], Optional[int]]]
@@ -204,7 +221,11 @@ class InProcessShard:
         return self.server.flush(timeout=timeout)
 
     def checkpoint(self) -> int:
-        return self.server.checkpoint_now()
+        step = self.server.checkpoint_now()
+        # duck-parity with HTTPShard: expose the committed watermarks so
+        # the owner of the shard's WalWriter can truncate covered segments
+        self.last_checkpoint_wal_marks = self.server.last_checkpoint_wal_marks
+        return step
 
     # ------------------------------------------------------------- migration
     def _require_live(self) -> None:
@@ -259,15 +280,31 @@ class LocalFleet:
         self.router = build_router(spec)
         self._servers: List[Optional[EvalServer]] = [None] * spec.num_shards
         self.coordinator: Optional[FleetCoordinator] = None
+        self._wal: Dict[int, WalWriter] = {}
         self._started = False
 
     # ------------------------------------------------------------- lifecycle
+    def _wal_writer(self, shard: int) -> Optional[WalWriter]:
+        """The shard's WalWriter, creating (and recovering) it on demand."""
+        if self.spec.wal_root is None:
+            return None
+        writer = self._wal.get(shard)
+        if writer is None:
+            writer = WalWriter(
+                os.path.join(self.spec.wal_root, f"shard_{shard:04d}"),
+                segment_bytes=self.spec.wal_segment_bytes,
+                fsync=self.spec.wal_fsync,
+            )
+            self._wal[shard] = writer
+        return writer
+
     def start(self) -> "LocalFleet":
         if self._started:
             raise MetricsTPUUserError("LocalFleet.start() called twice")
         self._started = True
         handles = []
         for shard in range(self.spec.num_shards):
+            self._wal_writer(shard)
             server = self._spawn_server(shard)
             self._servers[shard] = server
             handles.append(InProcessShard(server))
@@ -280,6 +317,7 @@ class LocalFleet:
             ring_capacity=self.spec.ring_capacity,
             ingest_dtype=self.spec.ingest_dtype,
             query_timeout=self.spec.query_timeout,
+            wal=self._wal if self.spec.wal_root is not None else None,
         ).start()
         return self
 
@@ -299,7 +337,11 @@ class LocalFleet:
         registry = build_shard_registry(
             self.spec, shard, self.router if router is None else router
         )
-        config = replace(self.spec.server_config, port=0)
+        config = replace(
+            self.spec.server_config,
+            port=0,
+            wal_exactly_once=self.spec.wal_root is not None,
+        )
         server = EvalServer(
             registry,
             config=config,
@@ -326,6 +368,11 @@ class LocalFleet:
         a grow adds, registered at the NEW router's spans.  Its zero state
         is replaced by ``migrate_in`` before any row is routed to it."""
         shard = int(shard)
+        writer = self._wal_writer(shard)
+        if writer is not None and self.coordinator is not None:
+            # grown shards get durable ingest too: the coordinator's map
+            # was copied at construction, so register the new writer there
+            self.coordinator._wal[shard] = writer
         server = self._spawn_server(shard, router=router)
         while len(self._servers) <= shard:
             self._servers.append(None)
@@ -340,6 +387,11 @@ class LocalFleet:
         if shard < len(self._servers) and self._servers[shard] is not None:
             self._servers[shard].stop(final_checkpoint=False)
             self._servers[shard] = None
+        writer = self._wal.pop(shard, None)
+        if writer is not None:
+            if self.coordinator is not None:
+                self.coordinator._wal.pop(shard, None)
+            writer.close()
 
     def server(self, shard: int) -> EvalServer:
         srv = self._servers[int(shard)]
@@ -349,11 +401,21 @@ class LocalFleet:
 
     # -------------------------------------------------------------- drills
     def checkpoint_all(self) -> Dict[int, int]:
-        """Flush + snapshot every shard; ``{shard: committed_step}``."""
-        return {
-            shard: self.server(shard).checkpoint_now()
-            for shard in range(self.spec.num_shards)
-        }
+        """Flush + snapshot every shard; ``{shard: committed_step}``.
+
+        With a WAL attached, each committed checkpoint's applied-seq
+        watermarks then garbage-collect the shard's log: sealed segments
+        every watermark covers can never be needed by a replay again.
+        """
+        steps: Dict[int, int] = {}
+        for shard in range(self.spec.num_shards):
+            server = self.server(shard)
+            steps[shard] = server.checkpoint_now()
+            writer = self._wal.get(shard)
+            marks = server.last_checkpoint_wal_marks
+            if writer is not None and marks:
+                writer.truncate_covered(marks)
+        return steps
 
     def kill_shard(self, shard: int) -> None:
         """Preemption: drop the shard's queue, no final checkpoint.  The
@@ -404,3 +466,6 @@ class LocalFleet:
         for shard, server in enumerate(self._servers):
             if server is not None:
                 server.stop(final_checkpoint=final_checkpoint)
+        for writer in self._wal.values():
+            writer.close()
+        self._wal = {}

@@ -363,6 +363,13 @@ class _Handler(BaseHTTPRequestHandler):
         blob of ``n`` stream ids.  Columns become ``np.frombuffer`` views
         over the request body — no per-record Python objects anywhere on
         this path — and enqueue as ONE ColumnBatch (one queue slot).
+
+        An optional ``"seqs": [[seq_or_null, rows], ...]`` header field
+        partitions the rows into WAL frames (they must sum to ``rows``):
+        the server then seq-dedups per frame, so duplicated forwards —
+        a coordinator POST retry, or a failover replay racing parked rows
+        — land exactly once (a deduped frame still counts as accepted:
+        idempotent success, not rejection).
         """
         import numpy as np
 
@@ -423,7 +430,26 @@ class _Handler(BaseHTTPRequestHandler):
             if with_ids
             else None
         )
-        ok = srv.submit_columns(name, tuple(cols), stream_ids=stream_ids)
+        seqs = header.get("seqs")
+        if seqs is not None:
+            if not isinstance(seqs, list) or not all(
+                isinstance(s, list)
+                and len(s) == 2
+                and (s[0] is None or isinstance(s[0], int))
+                and isinstance(s[1], int)
+                and not isinstance(s[1], bool)
+                and s[1] >= 1
+                for s in seqs
+            ):
+                raise MetricsTPUUserError(
+                    'ingest_columns "seqs" must be [[seq_or_null, rows>=1], ...]'
+                )
+            if sum(s[1] for s in seqs) != rows:
+                raise MetricsTPUUserError(
+                    'ingest_columns "seqs" row counts must sum to "rows"'
+                )
+            seqs = [(s[0], s[1]) for s in seqs]
+        ok = srv.submit_columns(name, tuple(cols), stream_ids=stream_ids, seqs=seqs)
         _obs.counter_inc("serve.column_batches", job=name)
         status = 200 if ok else 429
         self._send_json(
@@ -441,10 +467,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _checkpoint(self) -> None:
         """Operator-triggered durable snapshot (the coordinator's failover
-        drill checkpoints a shard before killing it)."""
+        drill checkpoints a shard before killing it).  ``wal_marks`` in the
+        response are the applied-seq watermarks the commit recorded — the
+        fleet truncates WAL segments they cover."""
         srv = self.server.eval_server
         step = srv.checkpoint_now()
-        self._send_json(200, {"step": int(step)})
+        out: Dict[str, Any] = {"step": int(step)}
+        marks = getattr(srv, "last_checkpoint_wal_marks", None)
+        if marks is not None:
+            out["wal_marks"] = dict(marks)
+        self._send_json(200, out)
 
     # ------------------------------------------------- elastic resize wire
     def _json_body(self) -> Dict[str, Any]:

@@ -71,19 +71,41 @@ class ColumnBatch(NamedTuple):
     Python object per record.  ``cols`` holds one ``(n, ...)`` array per
     update argument; ``stream_ids`` is an ``(n,)`` int32 array on
     multistream jobs and ``None`` on plain jobs.
+
+    ``seq`` is the batch's WAL frame sequence number when durable ingest
+    is on (``metrics_tpu.serve.wal``): the consumer advances its per-job
+    applied-seq watermark after folding the batch, and checkpoints persist
+    those watermarks so failover replays exactly the frames past them.
     """
 
     job: str
     cols: Tuple[np.ndarray, ...]
     stream_ids: Optional[np.ndarray] = None
+    seq: Optional[int] = None
 
 
 class _FlushToken:
     """Sentinel a producer enqueues to observe a drain point: the consumer
-    flushes every batcher, then sets the event."""
+    flushes every batcher, then sets the event.
 
-    def __init__(self) -> None:
+    A **hold** token additionally freezes the consumer at the drain point:
+    after flushing, the consumer snapshots its WAL watermarks into
+    ``marks``, signals ``done``, and then waits (bounded) on ``release``
+    before applying anything else.  Checkpoints use this so the saved
+    watermarks are *exactly* the state the snapshot contains — without the
+    hold, a frame applied between flush and encode would be inside the
+    snapshot but past the recorded watermark, and replay would double-apply
+    it.  Plain (non-hold) tokens behave exactly as before.
+    """
+
+    _HOLD_TIMEOUT = 60.0  # release is belt-and-braces bounded: a crashed
+    # checkpointer must not wedge the consumer forever
+
+    def __init__(self, hold: bool = False) -> None:
         self.done = threading.Event()
+        self.hold = bool(hold)
+        self.release = threading.Event()
+        self.marks: Dict[str, int] = {}
 
 
 class IngestQueue:
@@ -417,6 +439,11 @@ class IngestConsumer:
         self.kill = threading.Event()  # preemption: exit now, drop the queue
         self.errors: List[str] = []
         self.errors_total = 0
+        # per-job applied-seq watermarks (WAL mode): the highest frame seq
+        # whose rows this consumer has folded (or deterministically
+        # dropped).  Only this thread writes after seeding; checkpoint
+        # hold-tokens snapshot it at a quiesced drain point.
+        self.wal_marks: Dict[str, int] = {}
 
     def record_error(self, message: str) -> None:
         """Append to the bounded error log (a malformed-record flood must
@@ -470,7 +497,17 @@ class IngestConsumer:
     def _consume(self, item: Any, last_flush: float, now: float) -> float:
         if isinstance(item, _FlushToken):
             self.flush_all()
-            item.done.set()
+            if item.hold:
+                # quiesce for a watermark-exact checkpoint: the marks
+                # captured here describe precisely the rows the flush just
+                # folded, and nothing further folds until the checkpointer
+                # finishes encoding and releases us (bounded wait — see
+                # _FlushToken)
+                item.marks = dict(self.wal_marks)
+                item.done.set()
+                item.release.wait(_FlushToken._HOLD_TIMEOUT)
+            else:
+                item.done.set()
             return now
         try:
             batcher = self._batcher_for(item.job)
@@ -488,6 +525,17 @@ class IngestConsumer:
         except Exception as err:  # noqa: BLE001 — POST /ingest data is untrusted
             _obs.counter_inc("serve.records_malformed")
             self.record_error(f"{type(err).__name__}: {err}")
+        finally:
+            # the watermark advances even when the batch was dropped
+            # (malformed / retired job): a replay of the same frame would
+            # drop it identically, so "applied" means "its effect — possibly
+            # nothing — is in this state", keeping replay exactly-once and
+            # segment truncation unwedged
+            seq = getattr(item, "seq", None)
+            if seq is not None:
+                job = getattr(item, "job", None)
+                if job is not None and seq > self.wal_marks.get(job, -1):
+                    self.wal_marks[job] = int(seq)
         return last_flush
 
     def run(self) -> None:

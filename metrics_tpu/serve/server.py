@@ -64,6 +64,12 @@ class ServeConfig:
     ``flush_interval`` bounds ingest-to-state latency for partial blocks;
     ``durability_poll`` bounds how stale past ``max_staleness`` a crash can
     strand you, so keep it well under the manager's budget.
+
+    ``wal_exactly_once`` is set by the fleet when durable (WAL-backed)
+    ingest is on: checkpoints then quiesce the consumer at the flush point
+    (a *hold* token) so the per-job applied-seq watermarks they persist
+    describe exactly the snapshot's contents, and ``health()`` exposes the
+    live watermarks for failover replay.
     """
 
     host: str = "127.0.0.1"
@@ -74,6 +80,7 @@ class ServeConfig:
     poll_timeout: float = 0.02
     drain_timeout: float = 30.0
     durability_poll: float = 0.1
+    wal_exactly_once: bool = False
 
 
 class EvalServer:
@@ -110,6 +117,20 @@ class EvalServer:
         )
         self.last_checkpoint_step: Optional[int] = None
         self.restored_step: Optional[int] = None
+        # WAL seq-dedup floor: highest frame seq ENQUEUED per job (the
+        # consumer's wal_marks track the applied floor).  A forward retry
+        # or failover replay carrying seq <= this floor is dropped as an
+        # idempotent success — the exactly-once half the frontend's
+        # durable ack relies on.
+        self._wal_enqueued: Dict[str, int] = {}
+        self._wal_lock = threading.Lock()
+        try:
+            self._wal_lock.witness_name = "EvalServer._wal_lock"
+        except AttributeError:
+            pass
+        # watermarks the last committed checkpoint recorded (segment
+        # truncation reads these: frames at or below them can never replay)
+        self.last_checkpoint_wal_marks: Optional[Dict[str, int]] = None
         self._httpd = None
         self._threads: Dict[str, threading.Thread] = {}
         self._durability_stop = threading.Event()
@@ -138,6 +159,15 @@ class EvalServer:
             with self.registry.locked():
                 result = self.manager.restore(target)
             self.restored_step = self.last_checkpoint_step = result.step
+            marks = (result.extra or {}).get("wal_marks")
+            if marks:
+                # seed both dedup floors BEFORE any thread starts: frames at
+                # or below these seqs are inside the restored state, so a
+                # replay (or late retry) of them must land as a no-op
+                marks = {str(j): int(s) for j, s in marks.items()}
+                self.consumer.wal_marks.update(marks)
+                self._wal_enqueued.update(marks)
+                self.last_checkpoint_wal_marks = dict(marks)
             _obs.counter_inc("serve.restores")
         self._spawn("consumer", self.consumer.run)
         self._httpd = make_http_server(self.config.host, self.config.port, self)
@@ -184,6 +214,7 @@ class EvalServer:
         cols: Tuple[Any, ...],
         stream_ids: Optional[Any] = None,
         timeout: Optional[float] = None,
+        seqs: Optional[Any] = None,
     ) -> bool:
         """Enqueue many rows as ONE columnar batch (one queue slot).
 
@@ -191,13 +222,53 @@ class EvalServer:
         the sharded frontend forwards ring views through; the consumer
         carries them straight into block dispatches without ever
         materializing per-record Python objects.
+
+        ``seqs`` — ``[(seq_or_None, rows), ...]`` partitioning the rows
+        into WAL frames — turns the call idempotent: each framed slice
+        whose seq is at or below this worker's enqueue floor is dropped as
+        an already-landed duplicate (a forward retry or a failover replay),
+        everything else enqueues one :class:`ColumnBatch` per frame and
+        advances the floor.  Returns ``True`` only when every frame either
+        enqueued or deduped — a partial enqueue reports ``False`` so the
+        sender parks and retries the whole ship, and the floor makes that
+        retry exactly-once.
         """
         if self._draining:
             _obs.counter_inc("serve.records_rejected", reason="draining")
             return False
-        return self.queue.put(
-            ColumnBatch(job, tuple(cols), stream_ids), timeout=timeout
-        )
+        if seqs is None:
+            return self.queue.put(
+                ColumnBatch(job, tuple(cols), stream_ids), timeout=timeout
+            )
+        cols = tuple(cols)
+        total = int(len(cols[0])) if cols else 0
+        if sum(int(n) for _, n in seqs) != total:
+            raise MetricsTPUUserError(
+                f"seqs cover {sum(int(n) for _, n in seqs)} row(s) but the "
+                f"batch has {total}"
+            )
+        off = 0
+        with self._wal_lock:
+            for seq, n in seqs:
+                n = int(n)
+                part = tuple(c[off : off + n] for c in cols)
+                part_ids = (
+                    None if stream_ids is None else stream_ids[off : off + n]
+                )
+                off += n
+                if seq is not None:
+                    seq = int(seq)
+                    if seq <= self._wal_enqueued.get(job, -1):
+                        _obs.counter_inc("serve.wal_deduped_frames")
+                        _obs.counter_inc("serve.wal_deduped_rows", n)
+                        continue
+                if not self.queue.put(
+                    ColumnBatch(job, part, part_ids, seq), timeout=timeout
+                ):
+                    return False
+                if seq is not None:
+                    self._wal_enqueued[job] = seq
+        return True
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Force every partial block into metric state and wait for it.
@@ -222,6 +293,35 @@ class EvalServer:
         self.consumer.flush_all()
         return True
 
+    def _hold_flush(self, timeout: float = 10.0) -> Optional[_FlushToken]:
+        """:meth:`flush`, but freeze the consumer at the drain point.
+
+        Returns the completed hold token — its ``marks`` are the WAL
+        watermarks of exactly the state now folded in, and the consumer
+        stays parked until the caller sets ``token.release`` — or ``None``
+        on timeout.  The caller MUST release the token on every path.
+        """
+        deadline = time.monotonic() + float(timeout)
+        token = _FlushToken(hold=True)
+        consumer = self._threads.get("consumer")
+        while consumer is not None and consumer.is_alive():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.0:
+                return None
+            if self.queue.put_control(token, timeout=min(0.5, remaining)):
+                if token.done.wait(max(0.0, deadline - time.monotonic())):
+                    return token
+                # pre-release the abandoned token so the consumer, when it
+                # eventually reaches it, does not park for a caller that gave up
+                token.release.set()
+                return None
+        # the single writer has exited: nothing can race the encode, so an
+        # inline flush plus a direct mark snapshot is already quiesced
+        self.consumer.flush_all()
+        token.marks = dict(self.consumer.wal_marks)
+        token.done.set()
+        return token
+
     # ------------------------------------------------------------ durability
     def checkpoint_now(self, step: Optional[int] = None) -> int:
         """Flush, encode each job under its own lock, commit lock-free.
@@ -237,19 +337,51 @@ class EvalServer:
         if self.manager is None:
             raise MetricsTPUUserError("EvalServer has no CheckpointManager")
         with self._ckpt_lock:
-            if not self.flush():
+            hold: Optional[_FlushToken] = None
+            marks: Optional[Dict[str, int]] = None
+            if self.config.wal_exactly_once:
+                # hold-flush: the consumer parks between the flush and our
+                # release, so the watermarks below describe EXACTLY the rows
+                # the encode is about to snapshot — the invariant replay's
+                # exactly-once guarantee stands on
+                hold = self._hold_flush()  # analyze: ignore[lock-order] -- same contract the flush() chain is baselined under: every put_control and wait inside _hold_flush is deadline-bounded
+                if hold is not None:
+                    marks = dict(hold.marks)
+                else:
+                    _obs.counter_inc("serve.checkpoint_flush_timeouts")
+                    self.consumer.record_error(
+                        "checkpoint hold-flush timed out; snapshot misses "
+                        "buffered rows and keeps the previous watermarks"
+                    )
+                    # degraded but safe-side floor: the previous committed
+                    # marks are <= whatever this snapshot contains, so a
+                    # replay can duplicate at worst the timed-out window —
+                    # never silently drop acked rows
+                    marks = dict(self.last_checkpoint_wal_marks or {})
+            elif not self.flush():
                 # still a consistent snapshot, just missing buffered rows —
                 # commit it, but loudly: silent staleness is the real bug
                 _obs.counter_inc("serve.checkpoint_flush_timeouts")
                 self.consumer.record_error(
                     "checkpoint flush timed out; snapshot misses buffered rows"
                 )
-            target = self.registry.checkpoint_target()
-            encoded = self.manager.encode_target(
-                target, lock_for=self.registry.lock_for_checkpoint_key
-            )
-            committed = self.manager.save_now(target, step=step, encoded=encoded)
+            try:
+                target = self.registry.checkpoint_target()
+                encoded = self.manager.encode_target(
+                    target, lock_for=self.registry.lock_for_checkpoint_key
+                )
+                committed = self.manager.save_now(
+                    target,
+                    step=step,
+                    encoded=encoded,
+                    extra={"wal_marks": marks} if marks is not None else None,
+                )
+            finally:
+                if hold is not None:
+                    hold.release.set()
             self.last_checkpoint_step = committed
+            if marks is not None:
+                self.last_checkpoint_wal_marks = marks
         _obs.counter_inc("serve.checkpoints")
         _obs.counter_inc("serve.nonblocking_snapshots")
         return committed
@@ -415,6 +547,10 @@ class EvalServer:
         }
         if self.manager is not None:
             payload["checkpoint_staleness_secs"] = round(self.manager.staleness(), 3)
+        if self.config.wal_exactly_once:
+            # failover replay reads these: the coordinator re-ships every WAL
+            # frame past them to a freshly-restored replacement worker
+            payload["wal_marks"] = dict(self.consumer.wal_marks)
         return payload
 
     # --------------------------------------------------------------- shutdown

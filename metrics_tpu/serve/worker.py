@@ -55,8 +55,15 @@ def run_worker(
     block_rows: int = 64,
     flush_interval: float = 3600.0,
     max_staleness: Optional[float] = None,
+    wal_exactly_once: bool = False,
 ) -> EvalServer:
-    """Build shard ``shard``'s registry and start its server (started)."""
+    """Build shard ``shard``'s registry and start its server (started).
+
+    ``wal_exactly_once`` arms the worker side of the durable-ingest
+    protocol: seq-tagged frame dedup on ``/ingest_columns``, applied-seq
+    watermarks in every checkpoint, and ``wal_marks`` on ``/healthz`` —
+    the frontend (or a soak driver) owns the WalWriter itself.
+    """
     spec = FleetSpec(
         num_shards=int(num_shards),
         jobs=drill_jobs(num_streams),
@@ -68,6 +75,7 @@ def run_worker(
             # large interval = no wall-clock forcing: dispatch boundaries
             # stay a pure function of row count (the bitwise-drill contract)
             flush_interval=float(flush_interval),
+            wal_exactly_once=bool(wal_exactly_once),
         ),
         max_staleness=max_staleness,
     )
@@ -109,6 +117,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--block-rows", type=int, default=64)
     parser.add_argument("--flush-interval", type=float, default=3600.0)
     parser.add_argument("--max-staleness", type=float, default=None)
+    parser.add_argument(
+        "--wal-exactly-once",
+        action="store_true",
+        help="seq-dedup framed ingest and checkpoint applied-seq watermarks",
+    )
     args = parser.parse_args(argv)
 
     server = run_worker(
@@ -121,6 +134,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         block_rows=args.block_rows,
         flush_interval=args.flush_interval,
         max_staleness=args.max_staleness,
+        wal_exactly_once=args.wal_exactly_once,
     )
     print(f"READY {server.port}", flush=True)
 

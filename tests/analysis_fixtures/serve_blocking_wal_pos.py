@@ -1,0 +1,36 @@
+# serve-blocking positives for the WAL vocabulary: 5 findings expected
+# (2 banned-import — the from-import flags both the module and the name —
+# + 3 blocking-call: two fsync disk barriers outside the dedicated writer
+# thread and one checkpoint commit on the ack path).
+# The real wal.py carries these same primitives behind line-level
+# `# analyze: ignore[serve-blocking]` markers on the writer thread only —
+# this fixture is the unmarked twin proving the pass still polices them.
+from metrics_tpu.checkpoint import CheckpointManager  # banned-import
+
+import os
+
+
+class EagerDurableLog:
+    """A WAL whose *appenders* fsync inline — the exact anti-pattern the
+    group-commit writer thread exists to prevent: every producer thread
+    parks on the disk barrier instead of sharing one flush."""
+
+    def __init__(self, fh, manager):
+        self.fh = fh
+        self.manager = manager
+
+    def append(self, frame):
+        self.fh.write(frame)
+        self.fh.flush()
+        # blocking-call: a disk barrier on the request (appender) thread
+        os.fsync(self.fh.fileno())
+
+    def rotate(self, directory):
+        dir_fd = os.open(directory, os.O_RDONLY)
+        # blocking-call: the dirent barrier also belongs on the writer thread
+        os.fsync(dir_fd)
+        os.close(dir_fd)
+
+    def ack(self, target):
+        # blocking-call: a checkpoint commit inline with the durable ack
+        return self.manager.save_now(target)

@@ -4,8 +4,21 @@ Two subprocess workers behind one coordinator, process-mode load over
 HTTP, then a SIGKILL on one worker mid-ingest and a checkpointed respawn.
 The recovered fleet's ``compute_all`` must be bit-identical to an
 uninterrupted twin fleet fed the same records.  Run with ``-m slow``.
+
+Two loss models are drilled side by side:
+
+* the WAL-disabled contrast (``test_subprocess_fleet_kill9_failover_is_
+  bitwise``): rows fed after the kill park in the coordinator's ring and
+  are re-forwarded on failover — recovery leans on the *driver* still
+  holding the undelivered rows;
+* the durable drill (``test_subprocess_wal_kill_storm_zero_resend_is_
+  bitwise``): every row is flushed INTO the workers and acked before a
+  SIGKILL storm takes out the whole fleet between checkpoints.  The
+  driver re-sends nothing — recovery is checkpoint + WAL replay only,
+  and must still be bitwise.
 """
 
+import os
 import subprocess
 import sys
 import threading
@@ -20,6 +33,7 @@ from metrics_tpu.serve import (
     FleetCoordinator,
     FleetSpec,
     HTTPShard,
+    WalWriter,
     make_fleet_http_server,
     run_process_load,
 )
@@ -35,10 +49,11 @@ BLOCK = 8
 class WorkerProc:
     """One ``python -m metrics_tpu.serve.worker`` child + its HTTP handle."""
 
-    def __init__(self, shard, checkpoint_root, num_shards=NUM_SHARDS):
+    def __init__(
+        self, shard, checkpoint_root, num_shards=NUM_SHARDS, wal=False
+    ):
         self.shard = shard
-        self.proc = subprocess.Popen(
-            [
+        argv = [
                 sys.executable,
                 "-m",
                 "metrics_tpu.serve.worker",
@@ -47,7 +62,11 @@ class WorkerProc:
                 "--num-streams", str(S),
                 "--block-rows", str(BLOCK),
                 "--checkpoint-root", checkpoint_root,
-            ],
+        ]
+        if wal:
+            argv.append("--wal-exactly-once")
+        self.proc = subprocess.Popen(
+            argv,
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
             text=True,
@@ -74,14 +93,30 @@ class WorkerProc:
 class SubprocessFleet:
     """A coordinator over subprocess workers, with respawn-from-checkpoint."""
 
-    def __init__(self, checkpoint_root, num_shards=NUM_SHARDS):
+    def __init__(self, checkpoint_root, num_shards=NUM_SHARDS, wal_root=None):
         self.checkpoint_root = checkpoint_root
+        self.wal_root = wal_root
         spec = FleetSpec(num_shards=num_shards, jobs=drill_jobs(S))
         self.router = build_router(spec)
         self.workers = [
-            WorkerProc(shard, checkpoint_root, num_shards=num_shards)
+            WorkerProc(
+                shard,
+                checkpoint_root,
+                num_shards=num_shards,
+                wal=wal_root is not None,
+            )
             for shard in range(num_shards)
         ]
+        # the WAL lives with the DRIVER (the tier that fronts ingest), not
+        # the workers: acks become durable before a worker ever sees rows
+        self.wal = {}
+        if wal_root is not None:
+            for shard in range(num_shards):
+                # small segments so the drill exercises rotation + GC
+                self.wal[shard] = WalWriter(
+                    os.path.join(wal_root, f"shard_{shard:04d}"),
+                    segment_bytes=4096,
+                )
         self.coordinator = FleetCoordinator(
             self.router,
             [w.handle for w in self.workers],
@@ -89,6 +124,7 @@ class SubprocessFleet:
             provision=self._provision,
             retire=self._retire,
             ring_capacity=4096,
+            wal=self.wal or None,
         ).start()
 
     def _respawn(self, shard):
@@ -99,13 +135,17 @@ class SubprocessFleet:
             shard,
             self.checkpoint_root,
             num_shards=self.coordinator.router.num_shards,
+            wal=self.wal_root is not None,
         )
         self.workers[shard] = replacement
         return replacement.handle
 
     def _provision(self, shard, router):
         worker = WorkerProc(
-            shard, self.checkpoint_root, num_shards=router.num_shards
+            shard,
+            self.checkpoint_root,
+            num_shards=router.num_shards,
+            wal=self.wal_root is not None,
         )
         while len(self.workers) <= shard:
             self.workers.append(None)
@@ -141,17 +181,29 @@ class SubprocessFleet:
 
     def checkpoint_all(self):
         # the workers' HTTP POST /flush + /checkpoint routes, end to end
-        return {
+        steps = {
             w.shard: w.handle.checkpoint()
             for w in self.workers
             if w is not None
         }
+        # once a checkpoint commits, the segments its watermarks cover are
+        # garbage — same GC the LocalFleet runs
+        for w in self.workers:
+            if w is None:
+                continue
+            writer = self.wal.get(w.shard)
+            marks = w.handle.last_checkpoint_wal_marks
+            if writer is not None and marks:
+                writer.truncate_covered(marks)
+        return steps
 
     def stop(self):
         self.coordinator.stop()
         for w in self.workers:
             if w is not None:
                 w.terminate()
+        for writer in self.wal.values():
+            writer.close()
 
 
 @pytest.mark.slow
@@ -220,6 +272,78 @@ def test_subprocess_fleet_kill9_failover_is_bitwise(tmp_path):
         frontend.shutdown()
         http_thread.join(timeout=5.0)
         frontend.server_close()
+        fleet.stop()
+        twin.stop()
+
+
+@pytest.mark.slow
+def test_subprocess_wal_kill_storm_zero_resend_is_bitwise(tmp_path):
+    """The durable-ingest headline over REAL processes: every row flushed
+    into (and acked by) the workers, a SIGKILL storm takes the ENTIRE
+    fleet between checkpoints, and the driver re-sends nothing.  Recovery
+    is checkpoint restore + WAL replay from the applied-seq watermarks —
+    and ``compute_all`` must still be bit-identical to a never-killed
+    twin.  The WAL-disabled drill above is the contrast: there, recovery
+    leans on rows still parked in the coordinator's ring."""
+    fleet = SubprocessFleet(
+        str(tmp_path / "fleet"), wal_root=str(tmp_path / "fleet_wal")
+    )
+    twin = SubprocessFleet(str(tmp_path / "twin"))
+    try:
+        # phase 1: both fleets land the same rows and commit checkpoints
+        # (which carry the per-job applied-seq watermarks)
+        for f in (fleet, twin):
+            f.feed(0, 600)
+            assert f.coordinator.flush(60.0)
+            steps = f.checkpoint_all()
+            assert sorted(steps) == [0, 1]
+
+        # phase 2: rows PAST the checkpoint — flushed all the way into
+        # worker metric state and acked, so the coordinator's rings are
+        # EMPTY when the storm hits.  Only the WAL covers these rows.
+        for f in (fleet, twin):
+            f.feed(600, 900)
+            assert f.coordinator.flush(60.0)
+
+        # the storm: every worker dies at once, no drain, no checkpoint
+        for w in fleet.workers:
+            w.sigkill()
+        deadline = time.monotonic() + 30.0
+        while fleet.coordinator.health()["status"] != "degraded":
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        assert sorted(fleet.coordinator.health()["dead_shards"]) == [0, 1]
+
+        # recovery: failover only — the driver does NOT re-send a single
+        # row.  Replay must come from the log.
+        replayed_before = sum(
+            counter_value("serve.wal_replayed_rows", shard=str(s))
+            for s in range(NUM_SHARDS)
+        )
+        for shard in range(NUM_SHARDS):
+            fleet.coordinator.failover(shard)
+        assert fleet.coordinator.health()["status"] == "serving"
+        assert (
+            sum(
+                counter_value("serve.wal_replayed_rows", shard=str(s))
+                for s in range(NUM_SHARDS)
+            )
+            > replayed_before
+        )
+
+        for f in (fleet, twin):
+            assert f.coordinator.flush(60.0)
+        assert trees_bitwise_equal(
+            fleet.coordinator.compute_all(), twin.coordinator.compute_all()
+        )
+
+        # and the log GCs: a post-recovery checkpoint covers the replayed
+        # frames, so truncation reclaims the sealed segments
+        fleet.checkpoint_all()
+        lag = sum(w.lag_rows() for w in fleet.wal.values())
+        appended = sum(w.next_seq for w in fleet.wal.values())
+        assert appended > 0 and lag < 900 * 2  # strictly less than the feed
+    finally:
         fleet.stop()
         twin.stop()
 

@@ -129,6 +129,8 @@ FIXTURE_CASES = [
     ("serve_blocking_neg.py", "serve-blocking", 0, set()),
     ("serve_blocking_resize_pos.py", "serve-blocking", 3,
      {"banned-import", "blocking-call"}),
+    ("serve_blocking_wal_pos.py", "serve-blocking", 5,
+     {"banned-import", "blocking-call"}),
     ("trace_safety_pos.py", "trace-safety", 4,
      {"host-pull", "host-cast", "numpy-in-trace", "traced-branch"}),
     ("trace_safety_neg.py", "trace-safety", 0, set()),
@@ -150,6 +152,7 @@ FIXTURE_RELS = {
     "serve_blocking_pos.py": "metrics_tpu/serve/synthetic.py",
     "serve_blocking_neg.py": "metrics_tpu/serve/synthetic.py",
     "serve_blocking_resize_pos.py": "metrics_tpu/serve/synthetic.py",
+    "serve_blocking_wal_pos.py": "metrics_tpu/serve/synthetic.py",
 }
 
 

@@ -51,7 +51,10 @@ _SCRATCH = "serve-blocking"
 DEFAULT_DEPTH = 4
 
 # call names that block on peers: collectives, barriers, KV-store waits,
-# checkpoint commits (which barrier internally), and explicit metric syncs
+# checkpoint commits (which barrier internally), explicit metric syncs, and
+# disk barriers (fsync parks the caller until the device flushes — only the
+# WAL's dedicated writer thread may pay that, and wal.py opts its four call
+# sites out line-by-line with reasons rather than skipping the whole file)
 BLOCKING_CALLS = {
     "sync",
     "unsync",
@@ -71,6 +74,7 @@ BLOCKING_CALLS = {
     "maybe_save",
     "restore",
     "barrier",
+    "fsync",
 }
 
 # importing the distributed/checkpoint machinery into a request-path module
